@@ -1,0 +1,39 @@
+"""fedrec_tpu — a TPU-native federated news-recommendation framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+`VishnoiAman777/FedRec-with-PytorchDistributed` (reference mounted read-only at
+/root/reference): privacy-preserving federated learning of a two-tower news
+recommender (frozen-DistilBERT text encoder + multihead-attention user
+encoder) on the MIND / Adressa datasets.
+
+Design principles (TPU-first, not a port):
+  * One jitted SPMD train step over a ``jax.sharding.Mesh`` with a
+    ``clients`` axis — each TPU core simulates one federated client; gradient
+    / parameter federation is a ``lax.pmean`` over ICI instead of the
+    reference's gloo allreduce (reference ``main.py:117``,
+    ``Parameter_Averaging_main.py:144-148``).
+  * News representations live in an HBM-resident precomputed embedding table
+    gathered by nid inside the step, replacing the reference's per-sample
+    DistilBERT re-encode hot loop (reference ``model.py:41-61``).
+  * Sparse per-nid news-embedding gradients are ``jax.ops.segment_sum``
+    scatter-adds with static shapes (reference dict scatter ``main.py:20-52``).
+  * Local differential privacy is proper DP-SGD: per-example gradients via
+    ``vmap``, clipping, device-side Gaussian noise drawn from per-client PRNG
+    keys *before* the collective (honest version of reference
+    ``client.py:87-89,271-281``).
+
+Package layout:
+  config     — dataclass config system (replaces bare sys.argv parsing)
+  data       — MIND/Adressa pipelines, negative sampling, static-shape batchers
+  models     — Flax modules: attentions, encoders, two-tower recommender
+  ops        — Pallas TPU kernels + XLA fallbacks for the hot ops
+  parallel   — mesh construction, sharding, collectives, multi-host rendezvous
+  fed        — federated aggregation strategies (grad-avg / param-avg / coordinator)
+  privacy    — DP-SGD + RDP accountant (replaces Opacus)
+  train      — the single Trainer (ends the reference's 4-way copy-paste)
+  eval       — ranking metrics (AUC/MRR/NDCG) host- and device-side
+  utils      — PRNG, logging, profiling helpers
+  cli        — entry points mirroring the reference's driver scripts
+"""
+
+__version__ = "0.1.0"

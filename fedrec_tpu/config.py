@@ -1,0 +1,177 @@
+"""Configuration system for fedrec_tpu.
+
+The reference configures each driver through bare positional ``sys.argv``
+(reference ``main.py:178-184``, ``client.py:297-305``, ``server.py:108-113``)
+plus hardcoded constants scattered through the code (lr 5e-5 ``model.py:22-23``;
+npratio=4 / max_his_len=50 ``dataset.py:8-9``; DP constants C=2, delta=1e-5
+``client.py:220-224``). Here everything is a typed dataclass tree with
+``key=value`` CLI overrides and asdict round-tripping for checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class DataConfig:
+    """Dataset and sampling knobs (reference ``dataset.py:8-9,69-86``)."""
+
+    data_dir: str = "UserData"
+    dataset: str = "mind"              # "mind" | "adressa" | "synthetic"
+    npratio: int = 4                   # negatives per impression
+    max_his_len: int = 50              # click-history cap (pad id 0 = <unk>)
+    max_title_len: int = 50            # tokens per news title
+    batch_size: int = 64
+    shuffle: bool = True
+    seed: int = 0
+    drop_remainder: bool = True        # static shapes under jit
+
+
+@dataclass
+class ModelConfig:
+    """Two-tower model hyperparameters (reference ``encoder.py``, ``attention.py``)."""
+
+    news_dim: int = 400                # news/user embedding dim
+    num_heads: int = 20                # user-encoder MHA heads
+    head_dim: int = 20                 # d_k = d_v
+    query_dim: int = 200               # additive-attention query hidden
+    dropout_rate: float = 0.2
+    bert_hidden: int = 768             # DistilBERT hidden size
+    # "table"    — gather a precomputed news-embedding table (fast path)
+    # "head"     — frozen-trunk token states + trainable additive-attn/linear head
+    # "finetune" — full DistilBERT fine-tuned in-loop (BASELINE config 5)
+    text_encoder_mode: str = "table"
+    # numerics: the reference uses unstabilized exp-normalization
+    # (``attention.py:19,39``) — a defect; we default to stable softmax and keep
+    # the knob for bit-parity experiments.
+    stable_softmax: bool = True
+    # score->loss parity: CE over sigmoid(scores) (reference ``model.py:123-126``)
+    sigmoid_before_ce: bool = True
+    dtype: str = "float32"             # compute dtype for encoders ("bfloat16" on TPU)
+    use_pallas: bool = False           # route hot ops through Pallas kernels
+
+
+@dataclass
+class OptimConfig:
+    """Reference uses two inner Adams at lr 5e-5 (``model.py:22-23``)."""
+
+    user_lr: float = 5e-5
+    news_lr: float = 5e-5
+    optimizer: str = "adam"
+    grad_clip_norm: float = 0.0        # 0 = off (DP clipping is separate)
+
+
+@dataclass
+class FedConfig:
+    """Federation strategy (reference modes a-d, SURVEY.md section 0)."""
+
+    # "local"     — no federation (single client)
+    # "grad_avg"  — pmean of grads every step (Gradient_Averaging_main.py parity)
+    # "param_avg" — pmean of params every round  (Parameter_Averaging_main.py:144-148)
+    # "coordinator" — host-0 server broadcast/gather over DCN (client.py/server.py)
+    strategy: str = "param_avg"
+    num_clients: int = 8
+    local_epochs: int = 1              # client epochs per round
+    rounds: int = 10                   # global rounds (server.py global_epochs)
+    participation: float = 1.0         # fraction of clients aggregated per round
+    mesh_axis: str = "clients"
+
+
+@dataclass
+class PrivacyConfig:
+    """DP-SGD (honest version of reference ``client.py:87-89,220-225,271-281``)."""
+
+    enabled: bool = False
+    epsilon: float = 10.0
+    delta: float = 1e-5
+    clip_norm: float = 2.0             # C (MAX_GRAD_NORM, client.py:220)
+    # if sigma > 0 it overrides the accountant-calibrated value
+    sigma: float = 0.0
+    accountant_epochs: int = 50        # EPOCHS used for calibration (client.py:223)
+    # "dpsgd"  — per-example clip + noise on all trainable grads (correct)
+    # "ldp_news" — reference parity: noise only on news-embedding grads, no clipping
+    mechanism: str = "dpsgd"
+
+
+@dataclass
+class TrainConfig:
+    total_epochs: int = 10
+    save_every: int = 1                # snapshot cadence (reference main.py argv)
+    snapshot_dir: str = "snapshots"
+    resume: bool = True                # auto-resume if snapshot exists (main.py:113-115)
+    eval_every: int = 1
+    log_every: int = 10
+    seed: int = 42
+    profile: bool = False              # jax.profiler trace around the hot loop
+    wandb: bool = False
+    wandb_project: str = "fedrec_tpu"
+    run_name: str = "run"
+
+
+@dataclass
+class ExperimentConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    fed: FedConfig = field(default_factory=FedConfig)
+    privacy: PrivacyConfig = field(default_factory=PrivacyConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    # ------------------------------------------------------------------ io
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ExperimentConfig":
+        cfg = cls()
+        for section_name, section_val in d.items():
+            section = getattr(cfg, section_name, None)
+            if section is None or not dataclasses.is_dataclass(section):
+                raise KeyError(f"unknown config section: {section_name!r}")
+            for k, v in section_val.items():
+                if not hasattr(section, k):
+                    raise KeyError(f"unknown config key: {section_name}.{k}")
+                setattr(section, k, v)
+        return cfg
+
+    # ------------------------------------------------------- cli overrides
+    def apply_overrides(self, overrides: list[str]) -> "ExperimentConfig":
+        """Apply ``section.key=value`` strings (e.g. ``fed.num_clients=32``)."""
+        for item in overrides:
+            if "=" not in item:
+                raise ValueError(f"override must be section.key=value, got {item!r}")
+            path, raw = item.split("=", 1)
+            parts = path.split(".")
+            if len(parts) != 2:
+                raise ValueError(f"override path must be section.key, got {path!r}")
+            section_name, key = parts
+            section = getattr(self, section_name, None)
+            if section is None or not dataclasses.is_dataclass(section):
+                raise KeyError(f"unknown config section: {section_name!r}")
+            if not hasattr(section, key):
+                raise KeyError(f"unknown config key: {path!r}")
+            current = getattr(section, key)
+            setattr(section, key, _coerce(raw, type(current)))
+        return self
+
+
+def _coerce(raw: str, ty: type) -> Any:
+    if ty is bool:
+        low = raw.strip().lower()
+        if low in ("1", "true", "yes", "on"):
+            return True
+        if low in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"cannot parse bool from {raw!r}")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw

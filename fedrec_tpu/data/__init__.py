@@ -1,0 +1,21 @@
+from fedrec_tpu.data.mind import MindData, load_mind_artifacts, make_synthetic_mind
+from fedrec_tpu.data.sampling import newsample
+from fedrec_tpu.data.batcher import (
+    Batch,
+    IndexedSamples,
+    TrainBatcher,
+    index_samples,
+    shard_indices,
+)
+
+__all__ = [
+    "Batch",
+    "IndexedSamples",
+    "MindData",
+    "TrainBatcher",
+    "index_samples",
+    "load_mind_artifacts",
+    "make_synthetic_mind",
+    "newsample",
+    "shard_indices",
+]

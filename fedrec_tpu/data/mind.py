@@ -1,0 +1,92 @@
+"""MIND dataset artifacts: loading the reference's preprocessed format.
+
+The reference ships four artifacts under ``UserData/`` (reference
+``main.py:148-157``):
+
+  * ``bert_news_index.npy``  — int64 ``(N_news, 2, max_title_len)``:
+    per-news stacked [token_ids; attention_mask]
+  * ``bert_nid2index.pkl``   — dict ``nid str -> row index`` with ``<unk> -> 0``
+  * ``train_sam_uid.pkl`` / ``valid_sam_uid.pkl`` — impression samples
+    ``[uidx, pos_nid, neg_nids, history_nids, uid_str]``
+    (field order per reference ``dataset.py:81``: ``_, pos, neg, his, _``)
+
+This module loads those artifacts, plus a synthetic generator with identical
+shapes/dtypes for tests and benchmarks (the repo ships only a 4-sample shard).
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+
+@dataclass
+class MindData:
+    news_tokens: np.ndarray          # (N_news, 2, title_len) int64
+    nid2index: dict                  # nid -> row
+    train_samples: list              # [uidx, pos, negs, history, uid]
+    valid_samples: list
+
+    @property
+    def num_news(self) -> int:
+        return self.news_tokens.shape[0]
+
+    @property
+    def title_len(self) -> int:
+        return self.news_tokens.shape[2]
+
+
+def load_mind_artifacts(data_dir: str | Path) -> MindData:
+    data_dir = Path(data_dir)
+    news_tokens = np.load(data_dir / "bert_news_index.npy", allow_pickle=True)
+    with open(data_dir / "bert_nid2index.pkl", "rb") as f:
+        nid2index = pickle.load(f)
+    with open(data_dir / "train_sam_uid.pkl", "rb") as f:
+        train_samples = pickle.load(f)
+    with open(data_dir / "valid_sam_uid.pkl", "rb") as f:
+        valid_samples = pickle.load(f)
+    return MindData(news_tokens, nid2index, train_samples, valid_samples)
+
+
+def make_synthetic_mind(
+    num_news: int = 512,
+    num_train: int = 256,
+    num_valid: int = 64,
+    title_len: int = 50,
+    vocab: int = 30522,
+    his_len_range: tuple[int, int] = (5, 50),
+    neg_pool_range: tuple[int, int] = (4, 40),
+    seed: int = 0,
+) -> MindData:
+    """Synthetic MIND-shaped data for tests/benchmarks.
+
+    Index 0 is reserved for ``<unk>`` (all-zero tokens), matching the
+    reference artifact layout where ``nid2index['<unk>'] == 0``.
+    """
+    rng = np.random.default_rng(seed)
+    news_tokens = np.zeros((num_news, 2, title_len), dtype=np.int64)
+    lengths = rng.integers(5, title_len + 1, size=num_news)
+    for i in range(1, num_news):
+        ln = lengths[i]
+        news_tokens[i, 0, :ln] = rng.integers(1000, vocab, size=ln)
+        news_tokens[i, 1, :ln] = 1
+    nids = [f"N{i}" for i in range(num_news)]
+    nid2index = {"<unk>": 0}
+    for i in range(1, num_news):
+        nid2index[nids[i]] = i
+
+    def _make(n_samples: int) -> list:
+        samples = []
+        for s in range(n_samples):
+            his_len = int(rng.integers(*his_len_range, endpoint=True))
+            pool_len = int(rng.integers(*neg_pool_range, endpoint=True))
+            his = [nids[int(j)] for j in rng.integers(1, num_news, size=his_len)]
+            negs = [nids[int(j)] for j in rng.integers(1, num_news, size=pool_len)]
+            pos = nids[int(rng.integers(1, num_news))]
+            samples.append([s, pos, negs, his, f"U{s}"])
+        return samples
+
+    return MindData(news_tokens, nid2index, _make(num_train), _make(num_valid))

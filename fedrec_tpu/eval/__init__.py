@@ -1,0 +1,19 @@
+from fedrec_tpu.eval.metrics import (
+    auc_score,
+    compute_amn,
+    dcg_score,
+    evaluation_split,
+    mrr_score,
+    ndcg_score,
+    ranking_metrics_batch,
+)
+
+__all__ = [
+    "auc_score",
+    "compute_amn",
+    "dcg_score",
+    "evaluation_split",
+    "mrr_score",
+    "ndcg_score",
+    "ranking_metrics_batch",
+]

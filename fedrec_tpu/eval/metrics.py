@@ -1,0 +1,167 @@
+"""Ranking metrics: AUC / MRR / NDCG, host-side (numpy) and device-side (jnp).
+
+Semantics match the reference ``evaluation_functions.py:5-47`` (DCG with
+``2**rel - 1`` gains and log2 discounts, MRR normalized by the positive count,
+binary AUC) with two deliberate divergences, both recorded in the parity
+ledger:
+
+  * AUC is computed natively (Mann-Whitney U with average-rank tie handling,
+    identical to ``sklearn.roc_auc_score`` for binary labels) so the device
+    path has no sklearn dependency.
+  * Aggregation over a validation set is the *mean over impressions* — the
+    reference computes per-impression lists but returns only the final
+    sample's metrics (bug at reference ``client.py:166-171``).
+
+The jnp batch variant assumes the reference's fixed impression layout: one
+positive at slot 0 + ``npratio`` sampled negatives (reference
+``dataset.py:79-86``), which makes every metric a closed-form function of the
+positive's rank — ideal for the VPU (no sort needed, just comparisons).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# host-side (numpy) — API parity with reference evaluation_functions.py
+# --------------------------------------------------------------------------
+
+
+def dcg_score(y_true: np.ndarray, y_score: np.ndarray, k: int = 10) -> float:
+    """DCG@k with (2**rel - 1) gains (reference ``evaluation_functions.py:5-10``)."""
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_score = np.asarray(y_score, dtype=np.float64)
+    order = np.argsort(y_score)[::-1]
+    taken = np.take(y_true, order[:k])
+    gains = 2.0**taken - 1.0
+    discounts = np.log2(np.arange(len(taken)) + 2.0)
+    return float(np.sum(gains / discounts))
+
+
+def ndcg_score(y_true: np.ndarray, y_score: np.ndarray, k: int = 10) -> float:
+    """NDCG@k (reference ``evaluation_functions.py:13-16``)."""
+    best = dcg_score(y_true, y_true, k)
+    actual = dcg_score(y_true, y_score, k)
+    return actual / best
+
+
+def mrr_score(y_true: np.ndarray, y_score: np.ndarray) -> float:
+    """Mean reciprocal rank over positives (reference ``evaluation_functions.py:19-23``)."""
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_score = np.asarray(y_score, dtype=np.float64)
+    order = np.argsort(y_score)[::-1]
+    ranked = np.take(y_true, order)
+    rr = ranked / (np.arange(len(ranked)) + 1.0)
+    return float(np.sum(rr) / np.sum(y_true))
+
+
+def auc_score(y_true: np.ndarray, y_score: np.ndarray) -> float:
+    """Binary ROC-AUC via the Mann-Whitney U statistic with average ranks.
+
+    Equivalent to ``sklearn.metrics.roc_auc_score`` for binary labels
+    (reference imports sklearn at ``evaluation_functions.py:3``); implemented
+    natively so eval has no sklearn dependency.
+    """
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_score = np.asarray(y_score, dtype=np.float64)
+    n_pos = float(np.sum(y_true == 1))
+    n_neg = float(np.sum(y_true == 0))
+    if n_pos == 0 or n_neg == 0:
+        raise ValueError("AUC undefined: need at least one positive and one negative")
+    # average ranks (1-based) with tie correction
+    order = np.argsort(y_score, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(y_score) + 1, dtype=np.float64)
+    sorted_scores = y_score[order]
+    # assign average rank within tie groups
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            avg = 0.5 * (i + 1 + j + 1)
+            ranks[order[i : j + 1]] = avg
+        i = j + 1
+    rank_sum_pos = float(np.sum(ranks[y_true == 1]))
+    u = rank_sum_pos - n_pos * (n_pos + 1) / 2.0
+    return u / (n_pos * n_neg)
+
+
+def compute_amn(y_true: np.ndarray, y_score: np.ndarray) -> tuple[float, float, float, float]:
+    """(AUC, MRR, NDCG@5, NDCG@10) — reference ``evaluation_functions.py:26-31``."""
+    return (
+        auc_score(y_true, y_score),
+        mrr_score(y_true, y_score),
+        ndcg_score(y_true, y_score, 5),
+        ndcg_score(y_true, y_score, 10),
+    )
+
+
+def evaluation_split(
+    news_vecs: np.ndarray,
+    user_vecs: np.ndarray,
+    samples: list,
+    nid2index: dict,
+) -> np.ndarray:
+    """Offline split evaluation (reference ``evaluation_functions.py:33-47``).
+
+    For each impression: scores = news_vec . user_vec over positives +
+    negatives; returns an (n_valid, 4) array of per-impression (AUC, MRR,
+    NDCG@5, NDCG@10). Impressions whose metrics are undefined (e.g. no
+    negatives) are skipped, as the reference's try/except does.
+    """
+    results = []
+    for i, sample in enumerate(samples):
+        _, poss, negs, _, _ = sample
+        if isinstance(poss, str):
+            poss = [poss]
+        user_vec = user_vecs[i]
+        y_true = np.array([1] * len(poss) + [0] * len(negs))
+        news_ids = [nid2index[n] for n in list(poss) + list(negs)]
+        scores = news_vecs[news_ids] @ user_vec
+        try:
+            results.append(compute_amn(y_true, scores))
+        except ValueError:
+            continue
+    return np.array(results)
+
+
+# --------------------------------------------------------------------------
+# device-side (jnp) — vectorized closed forms for the fixed 1-pos + K-neg layout
+# --------------------------------------------------------------------------
+
+
+def ranking_metrics_batch(scores: jnp.ndarray, positive_index: int = 0) -> dict:
+    """Per-impression AUC/MRR/NDCG@5/NDCG@10 for fixed-size impressions, on device.
+
+    ``scores``: (B, C) candidate scores where column ``positive_index`` is the
+    single positive (reference layout ``dataset.py:83,86``: positive at slot 0,
+    label 0). With one positive among C candidates every metric depends only on
+    the positive's rank r (1-based):
+
+      AUC      = (C - r) / (C - 1)         (fraction of negatives outranked)
+      MRR      = 1 / r
+      NDCG@k   = 1/log2(r+1) if r <= k else 0
+
+    Ties are broken pessimistically against the positive (a negative with an
+    equal score outranks it), matching ``np.argsort``'s stable descending-order
+    behavior in the host metrics for the common all-distinct case and giving a
+    deterministic device result.
+    """
+    scores = jnp.asarray(scores)
+    b, c = scores.shape
+    pos = scores[:, positive_index][:, None]
+    # rank = 1 + number of candidates (excluding self) with score >= positive
+    others = jnp.concatenate(
+        [scores[:, :positive_index], scores[:, positive_index + 1 :]], axis=1
+    )
+    rank = 1.0 + jnp.sum(others >= pos, axis=1).astype(jnp.float32)
+    auc = (c - rank) / (c - 1)
+    mrr = 1.0 / rank
+    ndcg = 1.0 / jnp.log2(rank + 1.0)
+    ndcg5 = jnp.where(rank <= 5, ndcg, 0.0)
+    ndcg10 = jnp.where(rank <= 10, ndcg, 0.0)
+    return {"auc": auc, "mrr": mrr, "ndcg5": ndcg5, "ndcg10": ndcg10}

@@ -1,0 +1,93 @@
+"""Golden tests for ranking metrics vs sklearn + reference formulas."""
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.eval import (
+    auc_score,
+    compute_amn,
+    dcg_score,
+    mrr_score,
+    ndcg_score,
+    ranking_metrics_batch,
+)
+
+
+def _ref_dcg(y_true, y_score, k=10):
+    # the published formula (reference evaluation_functions.py:5-10)
+    order = np.argsort(y_score)[::-1]
+    y_true = np.take(y_true, order[:k])
+    gains = 2**y_true - 1
+    discounts = np.log2(np.arange(len(y_true)) + 2)
+    return np.sum(gains / discounts)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_dcg_ndcg_mrr_match_reference_formulas(seed):
+    rng = np.random.default_rng(seed)
+    n = 20
+    y_true = (rng.random(n) < 0.3).astype(np.float64)
+    if y_true.sum() == 0:
+        y_true[0] = 1
+    y_score = rng.standard_normal(n)
+    for k in (5, 10):
+        assert dcg_score(y_true, y_score, k) == pytest.approx(_ref_dcg(y_true, y_score, k))
+        best = _ref_dcg(y_true, y_true, k)
+        assert ndcg_score(y_true, y_score, k) == pytest.approx(
+            _ref_dcg(y_true, y_score, k) / best
+        )
+    order = np.argsort(y_score)[::-1]
+    taken = np.take(y_true, order)
+    ref_mrr = np.sum(taken / (np.arange(n) + 1)) / np.sum(y_true)
+    assert mrr_score(y_true, y_score) == pytest.approx(ref_mrr)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_auc_matches_sklearn(seed):
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    rng = np.random.default_rng(seed)
+    n = 50
+    y_true = (rng.random(n) < 0.4).astype(int)
+    y_true[0], y_true[1] = 1, 0  # ensure both classes
+    y_score = rng.standard_normal(n)
+    assert auc_score(y_true, y_score) == pytest.approx(
+        sklearn_metrics.roc_auc_score(y_true, y_score)
+    )
+    # with ties
+    y_score_t = np.round(y_score)  # heavy ties
+    assert auc_score(y_true, y_score_t) == pytest.approx(
+        sklearn_metrics.roc_auc_score(y_true, y_score_t)
+    )
+
+
+def test_compute_amn_returns_four_metrics():
+    y_true = np.array([1, 0, 0, 0, 0])
+    y_score = np.array([0.9, 0.5, 0.4, 0.3, 0.2])
+    auc, mrr, n5, n10 = compute_amn(y_true, y_score)
+    assert auc == 1.0 and mrr == 1.0 and n5 == 1.0 and n10 == 1.0
+
+
+def test_device_batch_metrics_match_host():
+    """Closed-form device metrics == host metrics for 1-pos + 4-neg impressions."""
+    rng = np.random.default_rng(3)
+    scores = rng.standard_normal((32, 5))
+    out = ranking_metrics_batch(scores)
+    y_true = np.array([1, 0, 0, 0, 0])
+    for i in range(32):
+        auc, mrr, n5, n10 = compute_amn(y_true, scores[i])
+        # device path is float32 — tolerate single-precision log2/div error
+        assert float(out["auc"][i]) == pytest.approx(auc, rel=1e-4)
+        assert float(out["mrr"][i]) == pytest.approx(mrr, rel=1e-4)
+        assert float(out["ndcg5"][i]) == pytest.approx(n5, rel=1e-4)
+        assert float(out["ndcg10"][i]) == pytest.approx(n10, rel=1e-4)
+
+
+def test_device_batch_metrics_rank_extremes():
+    # positive scored highest -> all perfect; lowest -> floor values
+    hi = np.array([[5.0, 1.0, 0.0, -1.0, -2.0]])
+    lo = np.array([[-5.0, 1.0, 0.0, -1.0, 2.0]])
+    out_hi = ranking_metrics_batch(hi)
+    out_lo = ranking_metrics_batch(lo)
+    assert float(out_hi["auc"][0]) == 1.0
+    assert float(out_lo["auc"][0]) == 0.0
+    assert float(out_lo["mrr"][0]) == pytest.approx(1 / 5)
