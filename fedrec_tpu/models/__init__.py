@@ -1,0 +1,13 @@
+from fedrec_tpu.models.attention import AdditiveAttention, MultiHeadAttention
+from fedrec_tpu.models.encoders import TextHead, UserEncoder
+from fedrec_tpu.models.recommender import NewsRecommender, score_candidates, score_loss
+
+__all__ = [
+    "AdditiveAttention",
+    "MultiHeadAttention",
+    "NewsRecommender",
+    "TextHead",
+    "UserEncoder",
+    "score_candidates",
+    "score_loss",
+]

@@ -1,0 +1,111 @@
+"""Attention modules (Flax linen), TPU-first re-designs of the reference's
+``attention.py``.
+
+Behavioral parity targets:
+  * ``AdditiveAttention`` — learned-query pooling ``fc(dh->hidden) -> tanh ->
+    fc(->1) -> normalize -> weighted sum`` (reference ``attention.py:8-26``).
+  * ``MultiHeadAttention`` — Q/K/V projections, scaled dot-product, **no
+    output projection** (reference ``attention.py:50-82``), Xavier-uniform
+    kernel init (reference ``attention.py:64-67``).
+
+Numerics divergence (ledger): the reference normalizes attention with a raw
+``exp`` (no max subtraction — ``attention.py:19,39``), which overflows for
+moderate logits. We default to a numerically-stable softmax and keep
+``stable_softmax=False`` for bit-parity experiments; with a mask both forms
+share the reference's ``alpha * mask / (sum + 1e-8)`` masking semantics.
+
+All shapes are batched leading dims + ``(seq, feature)`` trailing; everything
+lives inside one jit region so XLA fuses the pipelines into the MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _masked_normalize(
+    logits: jnp.ndarray, mask: jnp.ndarray | None, axis: int, stable: bool
+) -> jnp.ndarray:
+    """Reference-style exp-normalization, optionally max-stabilized.
+
+    ``exp(logits) * mask / (sum + 1e-8)`` — with ``stable=True`` the logits
+    are shifted by their max first, which changes nothing mathematically
+    (modulo the epsilon) but cannot overflow.
+    """
+    if stable:
+        logits = logits - jnp.max(logits, axis=axis, keepdims=True)
+    weights = jnp.exp(logits)
+    if mask is not None:
+        weights = weights * mask
+    return weights / (jnp.sum(weights, axis=axis, keepdims=True) + 1e-8)
+
+
+class AdditiveAttention(nn.Module):
+    """Learned-query additive pooling over a sequence: (..., L, D) -> (..., D)."""
+
+    hidden: int = 200
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, mask: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        e = nn.Dense(self.hidden, dtype=self.dtype, name="att_fc1")(x)
+        e = jnp.tanh(e)
+        logits = nn.Dense(1, dtype=self.dtype, name="att_fc2")(e)[..., 0]  # (..., L)
+        if mask is not None:
+            mask = mask.astype(logits.dtype)
+        alpha = _masked_normalize(logits, mask, axis=-1, stable=self.stable_softmax)
+        return jnp.einsum("...l,...ld->...d", alpha, x)
+
+
+class MultiHeadAttention(nn.Module):
+    """Multi-head scaled-dot-product attention WITHOUT output projection.
+
+    The reference concatenates per-head contexts and returns them directly
+    (``attention.py:81``); head mixing happens only implicitly in downstream
+    layers. Kernel init is Xavier-uniform to match ``attention.py:64-67``
+    (biases zero-init — the reference leaves torch's default bias init in
+    place, a divergence recorded in the ledger).
+    """
+
+    num_heads: int = 20
+    head_dim: int = 20
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        q: jnp.ndarray,
+        k: jnp.ndarray,
+        v: jnp.ndarray,
+        mask: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
+        d = self.num_heads * self.head_dim
+        dense = lambda name: nn.Dense(  # noqa: E731
+            d,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name=name,
+        )
+        *batch, L, _ = q.shape
+
+        def split_heads(x):
+            return x.reshape(*batch, -1, self.num_heads, self.head_dim)
+
+        q_s = split_heads(dense("w_q")(q))  # (..., L, H, Dk)
+        k_s = split_heads(dense("w_k")(k))
+        v_s = split_heads(dense("w_v")(v))
+
+        scores = jnp.einsum("...qhd,...khd->...hqk", q_s, k_s) / jnp.sqrt(
+            jnp.asarray(self.head_dim, dtype=q_s.dtype)
+        )
+        if mask is not None:
+            # (..., Lk) key mask broadcast over heads and query positions
+            mask = mask[..., None, None, :].astype(scores.dtype)
+        attn = _masked_normalize(scores, mask, axis=-1, stable=self.stable_softmax)
+        context = jnp.einsum("...hqk,...khd->...qhd", attn, v_s)
+        return context.reshape(*batch, L, d)

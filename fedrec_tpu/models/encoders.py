@@ -1,0 +1,80 @@
+"""News text head and user encoder (Flax linen).
+
+``TextHead`` is the trainable tail of the reference's ``TextEncoder``
+(additive attention over token states + Linear 768->400, reference
+``encoder.py:20-29``). The frozen DistilBERT trunk's per-news token states
+are constant, so the TPU design computes them once, caches them HBM- or
+host-resident, and only the head runs in the training step — numerically
+identical to the reference (whose trunk is frozen at ``model.py:25-26``) but
+without re-running BERT on every batch (the reference hot-loop flaw,
+``model.py:41-61``).
+
+``UserEncoder`` mirrors reference ``encoder.py:36-56``: dropout(0.2) ->
+multi-head self-attention over clicked-news vectors -> additive attention ->
+user vector. The reference passes no padding mask (history pad rows attend
+like real clicks); ``mask`` is optional here, default None for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fedrec_tpu.models.attention import AdditiveAttention, MultiHeadAttention
+
+
+class TextHead(nn.Module):
+    """(..., L, bert_hidden) token states -> (..., news_dim) news vector."""
+
+    news_dim: int = 400
+    bert_hidden: int = 768
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, token_states: jnp.ndarray, mask: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        # reference AdditiveAttention(hidden, hidden // 2) at encoder.py:20-21;
+        # reference passes NO token mask to the pooler (encoder.py:28)
+        pooled = AdditiveAttention(
+            hidden=self.bert_hidden // 2,
+            stable_softmax=self.stable_softmax,
+            dtype=self.dtype,
+            name="pool",
+        )(token_states, mask)
+        return nn.Dense(self.news_dim, dtype=self.dtype, name="fc")(pooled)
+
+
+class UserEncoder(nn.Module):
+    """(..., H, news_dim) clicked-news vectors -> (..., news_dim) user vector."""
+
+    news_dim: int = 400
+    num_heads: int = 20
+    head_dim: int = 20
+    query_dim: int = 200
+    dropout_rate: float = 0.2
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        clicked_vecs: jnp.ndarray,
+        mask: jnp.ndarray | None = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(clicked_vecs)
+        x = MultiHeadAttention(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            stable_softmax=self.stable_softmax,
+            dtype=self.dtype,
+            name="self_attn",
+        )(x, x, x, mask)
+        return AdditiveAttention(
+            hidden=self.query_dim,
+            stable_softmax=self.stable_softmax,
+            dtype=self.dtype,
+            name="pool",
+        )(x, mask)

@@ -1,0 +1,108 @@
+"""Two-tower news recommender: scoring + loss (reference ``model.py:111-129``).
+
+The reference's ``UserModel.forward`` embeds candidates and history via the
+text encoder, runs the user encoder, scores with a batched dot product,
+applies sigmoid, and feeds the *sigmoid outputs* to ``nn.CrossEntropyLoss``
+(reference ``model.py:121-126`` — CE over probabilities, not logits; an
+unusual choice we keep as the default for parity, with
+``sigmoid_before_ce=False`` exposing the standard logit CE).
+
+Here the model is a pure Flax module over *news vectors*; where those vectors
+come from (precomputed table gather, cached-trunk TextHead, or full DistilBERT
+fine-tune) is the caller's choice — see ``fedrec_tpu.train``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from fedrec_tpu.config import ModelConfig
+from fedrec_tpu.models.encoders import TextHead, UserEncoder
+
+
+def score_candidates(cand_vecs: jnp.ndarray, user_vec: jnp.ndarray) -> jnp.ndarray:
+    """Dot-product scoring: (..., C, D) x (..., D) -> (..., C).
+
+    The reference's ``torch.bmm(candidate_vecs, user_vector.unsqueeze(-1))``
+    (``model.py:121``) as one einsum; XLA maps it onto the MXU.
+    """
+    return jnp.einsum("...cd,...d->...c", cand_vecs, user_vec)
+
+
+def score_loss(
+    scores: jnp.ndarray, labels: jnp.ndarray, sigmoid_before_ce: bool = True
+) -> jnp.ndarray:
+    """Mean cross-entropy over impressions (labels are always slot 0).
+
+    ``sigmoid_before_ce=True`` reproduces reference ``model.py:123-126``:
+    ``CrossEntropyLoss()(sigmoid(scores), labels)``.
+    """
+    logits = nn.sigmoid(scores) if sigmoid_before_ce else scores
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+
+class NewsRecommender(nn.Module):
+    """User encoder + text head under one parameter tree.
+
+    Methods are exposed separately so the train step can call
+    ``encode_news`` on unique news only and reuse vectors across candidate
+    and history slots (the TPU answer to the reference re-encoding every
+    news per sample, ``model.py:41-61``).
+    """
+
+    cfg: ModelConfig
+
+    def setup(self):
+        dtype = jnp.dtype(self.cfg.dtype)
+        self.text_head = TextHead(
+            news_dim=self.cfg.news_dim,
+            bert_hidden=self.cfg.bert_hidden,
+            stable_softmax=self.cfg.stable_softmax,
+            dtype=dtype,
+        )
+        self.user_encoder = UserEncoder(
+            news_dim=self.cfg.news_dim,
+            num_heads=self.cfg.num_heads,
+            head_dim=self.cfg.head_dim,
+            query_dim=self.cfg.query_dim,
+            dropout_rate=self.cfg.dropout_rate,
+            stable_softmax=self.cfg.stable_softmax,
+            dtype=dtype,
+        )
+
+    def encode_news(
+        self, token_states: jnp.ndarray, mask: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        return self.text_head(token_states, mask)
+
+    def encode_user(
+        self,
+        his_vecs: jnp.ndarray,
+        mask: jnp.ndarray | None = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        return self.user_encoder(his_vecs, mask, train)
+
+    def __call__(
+        self,
+        cand_vecs: jnp.ndarray,
+        his_vecs: jnp.ndarray,
+        his_mask: jnp.ndarray | None = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """(..., C, D) candidates + (..., H, D) history -> (..., C) scores."""
+        user_vec = self.user_encoder(his_vecs, his_mask, train)
+        return score_candidates(cand_vecs, user_vec)
+
+    def init_both_towers(
+        self,
+        token_states: jnp.ndarray,
+        cand_vecs: jnp.ndarray,
+        his_vecs: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """Init helper: touches both towers so one ``init`` creates the full
+        parameter tree (Flax only materializes params for traced modules)."""
+        self.text_head(token_states)
+        return self(cand_vecs, his_vecs)
