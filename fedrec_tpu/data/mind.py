@@ -60,11 +60,17 @@ def make_synthetic_mind(
     his_len_range: tuple[int, int] = (5, 50),
     neg_pool_range: tuple[int, int] = (4, 40),
     seed: int = 0,
+    popular_frac: float = 0.0,
 ) -> MindData:
     """Synthetic MIND-shaped data for tests/benchmarks.
 
     Index 0 is reserved for ``<unk>`` (all-zero tokens), matching the
     reference artifact layout where ``nid2index['<unk>'] == 0``.
+
+    ``popular_frac > 0`` draws positives from only the first
+    ``popular_frac * num_news`` items while negatives come from the rest —
+    a popularity signal a recommender can actually learn, for
+    loss-decreases tests.
     """
     rng = np.random.default_rng(seed)
     news_tokens = np.zeros((num_news, 2, title_len), dtype=np.int64)
@@ -78,14 +84,28 @@ def make_synthetic_mind(
     for i in range(1, num_news):
         nid2index[nids[i]] = i
 
+    n_popular = max(1, int(popular_frac * num_news)) if popular_frac > 0 else 0
+    if n_popular and 1 + n_popular >= num_news:
+        raise ValueError(
+            f"popular_frac={popular_frac} leaves no negatives: "
+            f"{n_popular} popular items of {num_news} news (need >= 2 non-popular)"
+        )
+
     def _make(n_samples: int) -> list:
         samples = []
         for s in range(n_samples):
             his_len = int(rng.integers(*his_len_range, endpoint=True))
             pool_len = int(rng.integers(*neg_pool_range, endpoint=True))
             his = [nids[int(j)] for j in rng.integers(1, num_news, size=his_len)]
-            negs = [nids[int(j)] for j in rng.integers(1, num_news, size=pool_len)]
-            pos = nids[int(rng.integers(1, num_news))]
+            if n_popular:
+                pos = nids[int(rng.integers(1, 1 + n_popular))]
+                negs = [
+                    nids[int(j)]
+                    for j in rng.integers(1 + n_popular, num_news, size=pool_len)
+                ]
+            else:
+                negs = [nids[int(j)] for j in rng.integers(1, num_news, size=pool_len)]
+                pos = nids[int(rng.integers(1, num_news))]
             samples.append([s, pos, negs, his, f"U{s}"])
         return samples
 

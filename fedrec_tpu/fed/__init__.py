@@ -1,0 +1,19 @@
+from fedrec_tpu.fed.strategies import (
+    FedStrategy,
+    GradAvg,
+    Local,
+    ParamAvg,
+    get_strategy,
+    participation_mask,
+    weighted_param_avg,
+)
+
+__all__ = [
+    "FedStrategy",
+    "GradAvg",
+    "Local",
+    "ParamAvg",
+    "get_strategy",
+    "participation_mask",
+    "weighted_param_avg",
+]

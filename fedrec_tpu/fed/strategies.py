@@ -1,0 +1,125 @@
+"""Federated aggregation strategies — one interface, three reference modes.
+
+The reference implements federation three times with copy-paste drivers
+(SURVEY.md section 1): DDP gradient sync (``Gradient_Averaging_main.py:119,146``),
+explicit per-epoch parameter allreduce (``Parameter_Averaging_main.py:144-148``),
+and a hub-and-spoke server that broadcasts weights and gathers full
+state_dicts over TCP (``server.py:72-103``, ``client.py:256-291``). Here each
+mode is a small strategy object whose hooks are called *inside* the jitted
+SPMD train step, so the federation collectives compile into the same XLA
+program as the model math and ride ICI:
+
+  * ``GradAvg``  — ``sync_grads`` = ``lax.pmean`` each step (DDP parity)
+  * ``ParamAvg`` — ``sync_params`` = ``lax.pmean`` at round end (FedAvg with
+    equal weights, exactly ``all_reduce(param)/world_size``)
+  * ``Local``    — no cross-client communication (single-client / debugging)
+
+The coordinator deployment (server process + client processes) reuses
+``weighted_param_avg``: per-round participation masks generalize the
+equal-weight mean to client subsets, fixing the reference's "one client dies
+=> whole training dies" limitation (Final_Report.pdf section VII.a; see
+SURVEY.md section 5.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class FedStrategy:
+    """Hooks called inside the jitted step / round sync. Default: no comms.
+
+    ``sync_grads_every_step`` / ``sync_params_every_round`` are read by the
+    Trainer to decide which collectives to schedule; ``sync_grads`` runs
+    inside the per-batch step, ``sync_params`` inside the round-end sync
+    (``fedrec_tpu.train.step.build_param_sync``).
+    """
+
+    name = "local"
+    sync_grads_every_step = False
+    sync_params_every_round = False
+
+    def sync_grads(self, grads: Any, axis: str) -> Any:
+        return grads
+
+    def sync_params(self, params: Any, weight: jnp.ndarray, axis: str) -> Any:
+        return params
+
+
+class Local(FedStrategy):
+    pass
+
+
+class GradAvg(FedStrategy):
+    """Per-step gradient averaging (DDP-parity: reference
+    ``Gradient_Averaging_main.py:119`` — sync happens inside backward)."""
+
+    name = "grad_avg"
+    sync_grads_every_step = True
+
+    def sync_grads(self, grads: Any, axis: str) -> Any:
+        return lax.pmean(grads, axis_name=axis)
+
+
+class ParamAvg(FedStrategy):
+    """Per-round parameter averaging (FedAvg): reference
+    ``Parameter_Averaging_main.py:144-148`` — ``all_reduce(SUM)/world_size``.
+    Participation-weighted: equal weights reproduce the reference exactly."""
+
+    name = "param_avg"
+    sync_params_every_round = True
+
+    def sync_params(self, params: Any, weight: jnp.ndarray, axis: str) -> Any:
+        return weighted_param_avg(params, weight, axis)
+
+
+_STRATEGIES = {s.name: s for s in (Local, GradAvg, ParamAvg)}
+
+
+def get_strategy(name: str) -> FedStrategy:
+    # "coordinator" shares the device-side math with param_avg; its host-side
+    # round loop lives in fedrec_tpu.fed.coordinator
+    key = "param_avg" if name == "coordinator" else name
+    if key not in _STRATEGIES:
+        raise ValueError(f"unknown federation strategy {name!r}; have {sorted(_STRATEGIES)}")
+    return _STRATEGIES[key]()
+
+
+def participation_mask(
+    rng: jax.Array, num_clients: int, fraction: float
+) -> jnp.ndarray:
+    """(num_clients,) float mask with at least one participant per round.
+
+    Client dropout tolerance: rounds aggregate over the subset that reported
+    (the reference instead dies if any client fails — Final_Report.pdf
+    section VII.a).
+    """
+    if fraction >= 1.0:
+        return jnp.ones((num_clients,), dtype=jnp.float32)
+    scores = jax.random.uniform(rng, (num_clients,))
+    k = max(1, int(round(fraction * num_clients)))
+    threshold = jnp.sort(scores)[k - 1]
+    return (scores <= threshold).astype(jnp.float32)
+
+
+def weighted_param_avg(params: Any, weight: jnp.ndarray, axis: str) -> Any:
+    """Participation-weighted FedAvg inside ``shard_map``.
+
+    ``weight`` is this client's scalar round weight (0 = dropped out).
+    Every client — including non-participants — adopts the aggregate,
+    mirroring the coordinator broadcast (reference ``server.py:76-77``).
+    A round where NO client reports keeps everyone's local parameters
+    (rather than dividing by zero into NaN).
+    """
+    total = lax.psum(weight, axis_name=axis)
+    safe_total = jnp.where(total > 0, total, 1.0)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.where(
+            total > 0, lax.psum(p * weight, axis_name=axis) / safe_total, p
+        ),
+        params,
+    )

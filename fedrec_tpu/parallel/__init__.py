@@ -1,0 +1,13 @@
+from fedrec_tpu.parallel.mesh import (
+    client_mesh,
+    client_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "client_mesh",
+    "client_sharding",
+    "replicated_sharding",
+    "shard_batch",
+]

@@ -1,0 +1,19 @@
+from fedrec_tpu.train.state import ClientState, init_client_state, stack_states
+from fedrec_tpu.train.step import (
+    build_eval_step,
+    build_fed_train_step,
+    build_news_update_step,
+    build_param_sync,
+    encode_all_news,
+)
+
+__all__ = [
+    "ClientState",
+    "build_eval_step",
+    "build_fed_train_step",
+    "build_news_update_step",
+    "build_param_sync",
+    "encode_all_news",
+    "init_client_state",
+    "stack_states",
+]

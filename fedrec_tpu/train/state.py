@@ -1,0 +1,105 @@
+"""Training state: parameters + dual optimizer states + PRNG, as one pytree.
+
+The reference keeps two inner Adam optimizers on the model object (lr 5e-5,
+reference ``model.py:22-23``) plus a vestigial outer SGD (``main.py:171``) —
+here the state is an explicit immutable pytree: ``{user, news}`` parameter
+subtrees with separate optax states (preserving the two-optimizer structure,
+minus the dead outer SGD — ledger item), a per-client PRNG key, and the
+news-embedding-gradient accumulator for the decoupled (reference-parity)
+update path (``model.py:97-109`` ``collect``).
+
+Federated simulation stacks one ``ClientState`` per client along a leading
+axis that is sharded over the mesh's ``clients`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.models import NewsRecommender
+
+
+@struct.dataclass
+class ClientState:
+    step: jnp.ndarray                 # int32 scalar
+    user_params: Any                  # user-encoder subtree
+    news_params: Any                  # text-head subtree
+    opt_user: Any                     # optax state for user_params
+    opt_news: Any                     # optax state for news_params
+    rng: jax.Array                    # per-client PRNG key
+    news_grad_accum: jnp.ndarray      # (N_news, D) embedding-grad scatter target
+
+    def full_params(self) -> dict:
+        """Reassemble the flax variables dict for ``model.apply``."""
+        return {"params": {"user_encoder": self.user_params, "text_head": self.news_params}}
+
+
+def make_optimizers(cfg: ExperimentConfig) -> tuple[optax.GradientTransformation, optax.GradientTransformation]:
+    def _make(lr: float) -> optax.GradientTransformation:
+        txs = []
+        if cfg.optim.grad_clip_norm > 0:
+            txs.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))
+        if cfg.optim.optimizer == "adam":
+            txs.append(optax.adam(lr))
+        elif cfg.optim.optimizer == "sgd":
+            txs.append(optax.sgd(lr))
+        else:
+            raise ValueError(f"unknown optimizer {cfg.optim.optimizer!r}")
+        return optax.chain(*txs)
+
+    return _make(cfg.optim.user_lr), _make(cfg.optim.news_lr)
+
+
+def init_client_state(
+    model: NewsRecommender,
+    cfg: ExperimentConfig,
+    rng: jax.Array,
+    num_news: int,
+    title_len: int | None = None,
+) -> ClientState:
+    """Initialize one client's state (shapes from config; no data needed)."""
+    title_len = title_len or cfg.data.max_title_len
+    init_rng, state_rng = jax.random.split(rng)
+    dummy_states = jnp.zeros((1, title_len, cfg.model.bert_hidden), cfg.model.dtype)
+    dummy_cand = jnp.zeros((1, 1 + cfg.data.npratio, cfg.model.news_dim), cfg.model.dtype)
+    dummy_his = jnp.zeros((1, cfg.data.max_his_len, cfg.model.news_dim), cfg.model.dtype)
+    variables = model.init(
+        init_rng, dummy_states, dummy_cand, dummy_his,
+        method=NewsRecommender.init_both_towers,
+    )
+    user_params = variables["params"]["user_encoder"]
+    news_params = variables["params"]["text_head"]
+    opt_user_tx, opt_news_tx = make_optimizers(cfg)
+    return ClientState(
+        step=jnp.zeros((), jnp.int32),
+        user_params=user_params,
+        news_params=news_params,
+        opt_user=opt_user_tx.init(user_params),
+        opt_news=opt_news_tx.init(news_params),
+        rng=state_rng,
+        news_grad_accum=jnp.zeros((num_news, cfg.model.news_dim), jnp.float32),
+    )
+
+
+def stack_states(states: list[ClientState]) -> ClientState:
+    """Stack per-client states along a new leading (clients) axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def replicate_state(state: ClientState, num_clients: int, rng: jax.Array) -> ClientState:
+    """One init broadcast to all clients, with distinct per-client PRNG keys.
+
+    All clients start from identical parameters — matching the reference,
+    where the server broadcasts the initial model before round 1
+    (``server.py:76-77``).
+    """
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (num_clients,) + x.shape), state
+    )
+    return stacked.replace(rng=jax.random.split(rng, num_clients))

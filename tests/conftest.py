@@ -8,12 +8,23 @@ import time.
 
 import os
 
+# Prevent the axon TPU plugin's sitecustomize hook from registering: its
+# backend init can wedge every jax.devices() call (even JAX_PLATFORMS=cpu
+# goes through its get_backend wrapper) if the tunnel is busy/stale.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize may have already run register() at interpreter
+# startup (before this conftest) and pinned jax_platforms=axon; force it
+# back to cpu at the config level before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

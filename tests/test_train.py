@@ -1,0 +1,250 @@
+"""End-to-end federated training tests on a fake 8-device CPU mesh.
+
+The JAX-native analogue of the reference's localhost torchrun simulation
+(reference README.md:27-34): 8 virtual devices = 8 clients, loss must
+decrease, aggregation must match hand-computed math.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.data import TrainBatcher, index_samples, make_synthetic_mind
+from fedrec_tpu.fed import get_strategy
+from fedrec_tpu.models import NewsRecommender
+from fedrec_tpu.parallel import client_mesh, shard_batch
+from fedrec_tpu.train import (
+    build_fed_train_step,
+    build_news_update_step,
+    build_param_sync,
+    build_eval_step,
+    encode_all_news,
+)
+from fedrec_tpu.train.state import init_client_state, replicate_state
+
+
+def small_cfg(**over) -> ExperimentConfig:
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.data.max_his_len = 10
+    cfg.data.max_title_len = 12
+    cfg.data.batch_size = 8
+    cfg.fed.num_clients = 8
+    for k, v in over.items():
+        section, key = k.split("__")
+        setattr(getattr(cfg, section), key, v)
+    return cfg
+
+
+def make_setup(cfg, num_news=64, num_train=256, seed=0):
+    rng = np.random.default_rng(seed)
+    data = make_synthetic_mind(
+        num_news=num_news,
+        num_train=num_train,
+        num_valid=32,
+        title_len=cfg.data.max_title_len,
+        his_len_range=(2, cfg.data.max_his_len),
+        seed=seed,
+        popular_frac=0.2,  # learnable popularity signal
+    )
+    ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
+    batcher = TrainBatcher(
+        ix, cfg.data.batch_size, cfg.data.npratio, seed=seed
+    )
+    # synthetic frozen-trunk token states (stand-in for cached DistilBERT)
+    token_states = jnp.asarray(
+        rng.standard_normal((num_news, cfg.data.max_title_len, cfg.model.bert_hidden)).astype(
+            np.float32
+        )
+    )
+    model = NewsRecommender(cfg.model)
+    state0 = init_client_state(
+        model, cfg, jax.random.PRNGKey(seed), num_news, cfg.data.max_title_len
+    )
+    stacked = replicate_state(state0, cfg.fed.num_clients, jax.random.PRNGKey(seed + 1))
+    mesh = client_mesh(cfg.fed.num_clients)
+    return data, batcher, token_states, model, stacked, mesh
+
+
+def _batch_dict(b):
+    return {
+        "candidates": b.candidates,
+        "history": b.history,
+        "labels": b.labels,
+    }
+
+
+def test_joint_training_loss_decreases():
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    strategy = get_strategy("grad_avg")
+    step = build_fed_train_step(model, cfg, strategy, mesh, mode="joint")
+    losses = []
+    for epoch in range(4):
+        for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, epoch):
+            batch = shard_batch(mesh, _batch_dict(b))
+            stacked, metrics = step(stacked, batch, token_states)
+            losses.append(float(np.mean(np.asarray(metrics["mean_loss"]))))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses[0]} -> {losses[-1]}"
+
+
+def test_grad_avg_keeps_clients_in_lockstep():
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        batch = shard_batch(mesh, _batch_dict(b))
+        stacked, _ = step(stacked, batch, token_states)
+    # all clients saw identical (averaged) grads from identical init -> equal
+    leaves = jax.tree_util.tree_leaves(stacked.user_params)
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        np.testing.assert_allclose(arr[0], arr[-1], rtol=1e-4, atol=1e-5)
+
+
+def test_param_avg_round_sync_matches_hand_mean():
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("param_avg"), mesh, mode="joint")
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        batch = shard_batch(mesh, _batch_dict(b))
+        stacked, _ = step(stacked, batch, token_states)
+    # clients diverge during the round (no grad sync)
+    leaf0 = np.asarray(jax.tree_util.tree_leaves(stacked.user_params)[0])
+    assert not np.allclose(leaf0[0], leaf0[-1])
+    # round-end FedAvg: every client adopts the hand-computed mean
+    sync = build_param_sync(cfg, mesh)
+    weights = jnp.ones((cfg.fed.num_clients,), jnp.float32)
+    expected = {
+        i: np.mean(np.asarray(leaf), axis=0)
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(stacked.user_params))
+    }
+    synced = sync(stacked, weights)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(synced.user_params)):
+        arr = np.asarray(leaf)
+        for c in range(cfg.fed.num_clients):
+            np.testing.assert_allclose(arr[c], expected[i], rtol=1e-5, atol=1e-6)
+
+
+def test_participation_weighted_sync():
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("param_avg"), mesh, mode="joint")
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        stacked, _ = step(stacked, shard_batch(mesh, _batch_dict(b)), token_states)
+    sync = build_param_sync(cfg, mesh)
+    # only clients 0 and 3 participate this round
+    weights = jnp.zeros((cfg.fed.num_clients,), jnp.float32).at[0].set(1.0).at[3].set(1.0)
+    expected = {
+        i: 0.5 * (np.asarray(leaf)[0] + np.asarray(leaf)[3])
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(stacked.user_params))
+    }
+    synced = sync(stacked, weights)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(synced.user_params)):
+        arr = np.asarray(leaf)
+        for c in range(cfg.fed.num_clients):  # dropouts also adopt the aggregate
+            np.testing.assert_allclose(arr[c], expected[i], rtol=1e-5, atol=1e-6)
+
+
+def test_decoupled_mode_accumulates_and_updates_news_head():
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("param_avg"), mesh, mode="decoupled")
+    news_update = build_news_update_step(model, cfg, mesh)
+    # table from initial head params (client 0's copy; all clients identical)
+    p0 = jax.tree_util.tree_map(lambda x: x[0], stacked.news_params)
+    table = encode_all_news(model, p0, token_states)
+    before_accum = float(jnp.sum(jnp.abs(stacked.news_grad_accum)))
+    assert before_accum == 0.0
+    losses = []
+    for epoch in range(3):
+        for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, epoch):
+            stacked, metrics = step(stacked, shard_batch(mesh, _batch_dict(b)), table)
+            losses.append(float(np.mean(np.asarray(metrics["mean_loss"]))))
+        assert float(jnp.sum(jnp.abs(stacked.news_grad_accum))) > 0.0
+        old_news = jax.tree_util.tree_leaves(stacked.news_params)[0].copy()
+        stacked, new_tables = news_update(stacked, token_states)
+        # accumulator reset + head params moved + table refreshed per client
+        assert float(jnp.sum(jnp.abs(stacked.news_grad_accum))) == 0.0
+        assert not np.allclose(
+            np.asarray(old_news), np.asarray(jax.tree_util.tree_leaves(stacked.news_params)[0])
+        )
+        table = jax.tree_util.tree_map(lambda x: x[0], new_tables)
+    assert losses[-1] < losses[0]
+
+
+def test_eval_step_metrics_shape():
+    cfg = small_cfg()
+    data, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    p0 = jax.tree_util.tree_map(lambda x: x[0], stacked.news_params)
+    u0 = jax.tree_util.tree_map(lambda x: x[0], stacked.user_params)
+    table = encode_all_news(model, p0, token_states)
+    evaluate = build_eval_step(model, cfg)
+    ix = index_samples(data.valid_samples, data.nid2index, cfg.data.max_his_len)
+    vb = next(iter(TrainBatcher(ix, 16, cfg.data.npratio, seed=1).epoch_batches()))
+    out = evaluate(u0, table, _batch_dict(vb))
+    for k in ("auc", "mrr", "ndcg5", "ndcg10", "loss"):
+        v = float(out[k])
+        assert np.isfinite(v)
+    assert 0.0 <= float(out["auc"]) <= 1.0
+
+
+def test_zero_participation_round_keeps_local_params():
+    # review finding: an all-dropout round must not NaN the models
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("param_avg"), mesh, mode="joint")
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        stacked, _ = step(stacked, shard_batch(mesh, _batch_dict(b)), token_states)
+    sync = build_param_sync(cfg, mesh)
+    before = [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(stacked.user_params)]
+    synced = sync(stacked, jnp.zeros((cfg.fed.num_clients,), jnp.float32))
+    after = jax.tree_util.tree_leaves(synced.user_params)
+    for b_leaf, a_leaf in zip(before, after):
+        arr = np.asarray(a_leaf)
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(arr, b_leaf, rtol=1e-6)
+
+
+def test_grad_avg_sync_also_covers_news_head_in_decoupled_mode():
+    # review finding: GradAvg must keep the news tower in lockstep too
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    strategy = get_strategy("grad_avg")
+    step = build_fed_train_step(model, cfg, strategy, mesh, mode="decoupled")
+    news_update = build_news_update_step(model, cfg, mesh, strategy)
+    p0 = jax.tree_util.tree_map(lambda x: x[0], stacked.news_params)
+    table = encode_all_news(model, p0, token_states)
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        stacked, _ = step(stacked, shard_batch(mesh, _batch_dict(b)), table)
+    stacked, _ = news_update(stacked, token_states)
+    for leaf in jax.tree_util.tree_leaves(stacked.news_params):
+        arr = np.asarray(leaf)
+        np.testing.assert_allclose(arr[0], arr[-1], rtol=1e-4, atol=1e-6)
+
+
+def test_local_strategy_param_sync_is_identity():
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("param_avg"), mesh, mode="joint")
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        stacked, _ = step(stacked, shard_batch(mesh, _batch_dict(b)), token_states)
+    sync = build_param_sync(cfg, mesh, get_strategy("local"))
+    synced = sync(stacked, jnp.ones((cfg.fed.num_clients,), jnp.float32))
+    for a, b_leaf in zip(
+        jax.tree_util.tree_leaves(stacked.user_params),
+        jax.tree_util.tree_leaves(synced.user_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_leaf), rtol=1e-6)
+
+
+def test_popular_frac_validation():
+    with pytest.raises(ValueError, match="popular_frac"):
+        make_synthetic_mind(num_news=10, popular_frac=0.95)
