@@ -1,0 +1,105 @@
+"""Benchmark: flagship federated train-step throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N, ...}
+
+Measured workload — identical math and shapes to the recorded torch-CPU
+reference-equivalent baseline (``benchmarks/torch_baseline.py``, results in
+``benchmarks/baseline_host.json``): per-batch training of the two-tower
+recommender (trainable text head over cached frozen-trunk token states +
+20-head user encoder + sigmoid-CE), B=64 impressions, 5 candidates, 50-item
+history, 50-token titles. The reference's federated deployment runs this math
+per-sample in torch/gloo on CPU nodes (reference ``README.md:13,86``,
+``model.py:41-61``); ours is one jitted XLA program on the TPU chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.fed import get_strategy
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.parallel import client_mesh, shard_batch
+    from fedrec_tpu.train import build_fed_train_step
+    from fedrec_tpu.train.state import init_client_state, replicate_state
+
+    platform = jax.devices()[0].platform
+
+    cfg = ExperimentConfig()
+    cfg.fed.num_clients = 1
+    cfg.data.batch_size = 64
+    num_news, L = 4096, cfg.data.max_title_len
+    B, C, H = cfg.data.batch_size, 1 + cfg.data.npratio, cfg.data.max_his_len
+
+    rng = np.random.default_rng(0)
+    token_states = jnp.asarray(
+        rng.standard_normal((num_news, L, cfg.model.bert_hidden)).astype(np.float32)
+    )
+    model = NewsRecommender(cfg.model)
+    state0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, L)
+    stacked = replicate_state(state0, 1, jax.random.PRNGKey(1))
+    mesh = client_mesh(1)
+    step = build_fed_train_step(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
+
+    def make_batch(seed: int):
+        r = np.random.default_rng(seed)
+        return shard_batch(
+            mesh,
+            {
+                "candidates": r.integers(0, num_news, (1, B, C)).astype(np.int32),
+                "history": r.integers(0, num_news, (1, B, H)).astype(np.int32),
+                "labels": np.zeros((1, B), np.int32),
+            },
+        )
+
+    batches = [make_batch(s) for s in range(8)]
+
+    # warmup / compile
+    for i in range(3):
+        stacked, metrics = step(stacked, batches[i % 8], token_states)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        stacked, metrics = step(stacked, batches[i % 8], token_states)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    samples_per_sec = B / dt
+
+    baseline_path = Path(__file__).parent / "benchmarks" / "baseline_host.json"
+    vs_baseline = None
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text())
+        vs_baseline = samples_per_sec / base["samples_per_sec"]
+
+    print(
+        json.dumps(
+            {
+                "metric": "fedrec_train_step_throughput",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+                "platform": platform,
+                "sec_per_step": round(dt, 6),
+                "batch_size": B,
+                "baseline": "torch-cpu reference-equivalent, see benchmarks/baseline_host.json",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Reference-equivalent torch-CPU baseline for bench.py's ``vs_baseline``.
+
+The reference's federated deployment runs torch on CPU EC2 t2.medium nodes
+with the gloo backend (reference ``README.md:13,86``; gloo selected in every
+driver, e.g. ``client.py:227``). This script measures the *most favorable
+reasonable* torch implementation of the same per-batch training math our
+flagship step performs:
+
+  * news vectors from the trainable text head over precomputed frozen-trunk
+    token states (768 -> additive attention -> 400), B*(C+H) titles per batch
+    (the reference re-encodes per sample with no dedup, ``model.py:41-61``;
+    we grant the baseline batched encoding, but full-batch no-dedup like the
+    reference)
+  * user encoder: 20-head self-attention + additive attention (400-d)
+  * dot-product scores, sigmoid, CE, backward, Adam step on both towers
+
+This is an independent torch implementation of the documented math — not a
+copy of the reference code. Results land in ``benchmarks/baseline_host.json``
+and are read by ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import platform
+import time
+from pathlib import Path
+
+import numpy as np
+import torch
+from torch import nn
+
+
+class AdditivePool(nn.Module):
+    def __init__(self, dim: int, hidden: int):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, 1)
+
+    def forward(self, x):  # (B, L, D) -> (B, D)
+        logits = self.fc2(torch.tanh(self.fc1(x))).squeeze(-1)
+        alpha = torch.softmax(logits, dim=-1)
+        return torch.einsum("bl,bld->bd", alpha, x)
+
+
+class TextHeadT(nn.Module):
+    def __init__(self, bert_hidden=768, news_dim=400):
+        super().__init__()
+        self.pool = AdditivePool(bert_hidden, bert_hidden // 2)
+        self.fc = nn.Linear(bert_hidden, news_dim)
+
+    def forward(self, states):
+        return self.fc(self.pool(states))
+
+
+class UserEncoderT(nn.Module):
+    def __init__(self, news_dim=400, heads=20, head_dim=20, query_dim=200):
+        super().__init__()
+        d = heads * head_dim
+        self.heads, self.head_dim = heads, head_dim
+        self.wq = nn.Linear(news_dim, d)
+        self.wk = nn.Linear(news_dim, d)
+        self.wv = nn.Linear(news_dim, d)
+        self.pool = AdditivePool(d, query_dim)
+
+    def forward(self, his):  # (B, H, D)
+        B, H, _ = his.shape
+        q = self.wq(his).view(B, H, self.heads, self.head_dim).transpose(1, 2)
+        k = self.wk(his).view(B, H, self.heads, self.head_dim).transpose(1, 2)
+        v = self.wv(his).view(B, H, self.heads, self.head_dim).transpose(1, 2)
+        attn = torch.softmax(q @ k.transpose(-1, -2) / math.sqrt(self.head_dim), dim=-1)
+        ctx = (attn @ v).transpose(1, 2).reshape(B, H, -1)
+        return self.pool(ctx)
+
+
+def run(batch_size=64, cand=5, his_len=50, title_len=50, num_news=4096,
+        warmup=1, iters=3, seed=0):
+    torch.manual_seed(seed)
+    rng = np.random.default_rng(seed)
+    states_table = torch.randn(num_news, title_len, 768)
+    head = TextHeadT()
+    user = UserEncoderT()
+    opt = torch.optim.Adam(list(head.parameters()) + list(user.parameters()), lr=5e-5)
+    ce = nn.CrossEntropyLoss()
+
+    def step():
+        cand_ids = torch.from_numpy(rng.integers(0, num_news, (batch_size, cand)))
+        his_ids = torch.from_numpy(rng.integers(0, num_news, (batch_size, his_len)))
+        ids = torch.cat([cand_ids.reshape(-1), his_ids.reshape(-1)])
+        vecs = head(states_table[ids])  # (B*(C+H), 400) — no dedup, like the reference
+        cand_vecs = vecs[: batch_size * cand].view(batch_size, cand, -1)
+        his_vecs = vecs[batch_size * cand:].view(batch_size, his_len, -1)
+        user_vec = user(his_vecs)
+        scores = torch.einsum("bcd,bd->bc", cand_vecs, user_vec)
+        loss = ce(torch.sigmoid(scores), torch.zeros(batch_size, dtype=torch.long))
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        return float(loss)
+
+    for _ in range(warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "impl": "torch-cpu reference-equivalent (text head over cached trunk states + user encoder)",
+        "batch_size": batch_size,
+        "candidates": cand,
+        "his_len": his_len,
+        "title_len": title_len,
+        "sec_per_step": dt,
+        "samples_per_sec": batch_size / dt,
+        "torch_version": torch.__version__,
+        "cpu": platform.processor() or platform.machine(),
+        "num_threads": torch.get_num_threads(),
+    }
+
+
+if __name__ == "__main__":
+    result = run()
+    out = Path(__file__).parent / "baseline_host.json"
+    out.write_text(json.dumps(result, indent=2))
+    print(json.dumps(result, indent=2))
