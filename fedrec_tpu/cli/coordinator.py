@@ -1,0 +1,147 @@
+"""Coordinator deployment driver — the reference's client.py/server.py pair.
+
+One script for both roles (the reference needs two divergent scripts plus a
+raw-TCP side channel; see SURVEY.md section 2.3). Each participating host
+runs:
+
+  python -m fedrec_tpu.cli.coordinator ROUNDS BATCH SAVE_EVERY \
+      --coordinator HOST:PORT --num-processes N --process-id I \
+      [--dp-epsilon 10] [--server-trains] [--set section.key=value ...]
+
+Process 0 is the aggregation server (reference uses rank 1,
+``client.py:257``). Round loop parity:
+
+  * continue/stop flag broadcast  (reference ``server.py:74,105``)
+  * server weight fan-out          (``server.py:76-77``) — one pytree
+    broadcast over DCN, not per-tensor gloo broadcasts + TCP files
+  * local training epochs          (``client.py:284``)
+  * participation-weighted gather  (``server.py:80-103``) — clients that
+    miss a round simply contribute weight 0 instead of killing the job
+    (fixes Final_Report.pdf VII.a)
+
+Runs standalone too (single process): degrades to local FedAvg.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from fedrec_tpu.cli.run import build_parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    parser.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                        help="rendezvous address (omit for single-process)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--server-trains", action="store_true",
+                        help="process 0 also trains (reference server does not)")
+    args = parser.parse_args(argv)
+
+    from fedrec_tpu.parallel.multihost import (
+        CoordinatorRuntime,
+        initialize_distributed,
+    )
+
+    if args.coordinator is not None:
+        initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
+    from fedrec_tpu.privacy import calibrate_sigma
+    from fedrec_tpu.train.trainer import Trainer
+
+    rt = CoordinatorRuntime()
+
+    cfg = ExperimentConfig()
+    cfg.fed.rounds = args.total_epochs
+    cfg.data.batch_size = args.batch_size
+    cfg.train.save_every = args.save_every
+    # local aggregation within each host's mesh stays param_avg; cross-host
+    # aggregation goes through the coordinator runtime
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.local_epochs = args.local_epochs
+    cfg.fed.num_clients = args.clients or len(jax.local_devices())
+    cfg.apply_overrides(args.overrides)
+
+    if args.synthetic:
+        data = make_synthetic_mind(
+            num_news=512, num_train=2048, num_valid=256,
+            title_len=cfg.data.max_title_len, popular_frac=0.2,
+        )
+    else:
+        data = load_mind_artifacts(args.data_dir)
+
+    token_path = args.token_states or str(Path(args.data_dir) / "token_states.npy")
+    if Path(token_path).exists():
+        token_states = np.load(token_path)
+    else:
+        token_states = np.random.default_rng(0).standard_normal(
+            (data.num_news, data.title_len, cfg.model.bert_hidden)
+        ).astype(np.float32)
+
+    if args.dp_epsilon > 0:
+        cfg.privacy.enabled = True
+        cfg.privacy.epsilon = args.dp_epsilon
+        n_train = max(len(data.train_samples), 1)
+        q = min(1.0, cfg.data.batch_size / max(n_train // cfg.fed.num_clients, 1))
+        steps = max(n_train // (cfg.fed.num_clients * cfg.data.batch_size), 1)
+        cfg.privacy.sigma = calibrate_sigma(
+            cfg.privacy.epsilon, cfg.privacy.delta, q,
+            steps * cfg.privacy.accountant_epochs,
+        )
+
+    trains = args.server_trains or not rt.is_server or rt.num_processes == 1
+    if rt.num_processes > 1:
+        # orbax snapshots need whole-world coordination; in the coordinator
+        # deployment the server instead persists the global model per round
+        # (the reference's model.pt / received_model_{i}.pt artifacts,
+        # client.py:288 / server.py:27)
+        snapshot_dir = Path(cfg.train.snapshot_dir or "snapshots")
+        cfg.train.snapshot_dir = ""
+    trainer = Trainer(cfg, data, token_states)
+
+    round_idx = trainer.start_round
+    while rt.start_round(round_idx, cfg.fed.rounds):
+        # server fan-out: everyone adopts the global model
+        u0, n0 = trainer._client0_params()
+        u, n = rt.sync_from_server((u0, n0))
+        trainer.set_global_params(u, n)
+
+        result = None
+        if trains:
+            result = trainer.train_round(round_idx)
+
+        # gather: participation weight 0 for a non-training server
+        u0, n0 = trainer._client0_params()
+        u, n = rt.aggregate((u0, n0), participated=trains)
+        trainer.set_global_params(u, n)
+
+        if result is not None:
+            log = {"round": round_idx, "training_loss": result.train_loss}
+            log.update(result.val_metrics)
+            trainer.logger.log(round_idx, log)
+        if (round_idx + 1) % cfg.train.save_every == 0:
+            if trainer.snapshots is not None:
+                trainer.snapshots.save(round_idx, trainer.state)
+            elif rt.is_server:
+                from flax import serialization
+
+                snapshot_dir.mkdir(parents=True, exist_ok=True)
+                (snapshot_dir / f"global_round_{round_idx}.msgpack").write_bytes(
+                    serialization.to_bytes({"user": u, "news": n, "round": round_idx})
+                )
+        round_idx += 1
+
+    print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Unified CLI driver — replaces the reference's four entry scripts.
+
+Reference drivers and their equivalents here (positional args kept
+compatible with ``torchrun ... <script> epochs batch save_every``,
+reference ``main.py:178-184``):
+
+  * ``main.py`` (DDP simulation)            -> ``--strategy grad_avg``
+  * ``Gradient_Averaging_main.py``          -> ``--strategy grad_avg``
+  * ``Parameter_Averaging_main.py``         -> ``--strategy param_avg``
+  * ``client.py``/``server.py`` coordinator -> ``--strategy coordinator``
+    (multi-host; see fedrec_tpu.parallel.multihost)
+
+Usage:
+  python -m fedrec_tpu.cli.run EPOCHS BATCH SAVE_EVERY \
+      [--strategy param_avg] [--clients 8] [--data-dir UserData] \
+      [--dp-epsilon 10] [--set section.key=value ...]
+
+Unlike the reference there is no torchrun/c10d rendezvous to stand up: the
+clients are mesh slots of one SPMD program (single host) or
+``jax.distributed``-initialized processes (multi-host).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("total_epochs", type=int, help="global rounds (reference argv 1)")
+    p.add_argument("batch_size", type=int, help="per-client batch size (argv 2)")
+    p.add_argument("save_every", type=int, help="snapshot cadence in rounds (argv 3)")
+    p.add_argument("--strategy", default="param_avg",
+                   choices=["local", "grad_avg", "param_avg", "coordinator"])
+    p.add_argument("--clients", type=int, default=None,
+                   help="default: all visible devices")
+    p.add_argument("--data-dir", default="/root/reference/UserData",
+                   help="directory with bert_news_index.npy etc.")
+    p.add_argument("--token-states", default=None,
+                   help="path to cached (N, L, H) trunk token states .npy; "
+                        "default <data-dir>/token_states.npy if present, else "
+                        "random states (smoke mode)")
+    p.add_argument("--dp-epsilon", type=float, default=0.0,
+                   help="enable LDP with this epsilon (reference argv 4; 0 = off)")
+    p.add_argument("--local-epochs", type=int, default=1)
+    p.add_argument("--participation", type=float, default=1.0)
+    p.add_argument("--mode", default=None, choices=[None, "joint", "decoupled"])
+    p.add_argument("--synthetic", action="store_true",
+                   help="use synthetic data instead of --data-dir artifacts")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="SECTION.KEY=VALUE")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
+    from fedrec_tpu.privacy import calibrate_sigma
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig()
+    cfg.fed.rounds = args.total_epochs
+    cfg.data.batch_size = args.batch_size
+    cfg.train.save_every = args.save_every
+    cfg.fed.strategy = args.strategy
+    cfg.fed.local_epochs = args.local_epochs
+    cfg.fed.participation = args.participation
+    cfg.fed.num_clients = args.clients or len(jax.local_devices())
+    if args.mode:
+        cfg.model.text_encoder_mode = "table" if args.mode == "decoupled" else "head"
+    cfg.apply_overrides(args.overrides)
+
+    if args.synthetic:
+        data = make_synthetic_mind(
+            num_news=512, num_train=2048, num_valid=256,
+            title_len=cfg.data.max_title_len, popular_frac=0.2,
+        )
+    else:
+        data = load_mind_artifacts(args.data_dir)
+
+    token_path = args.token_states or str(Path(args.data_dir) / "token_states.npy")
+    if Path(token_path).exists():
+        token_states = np.load(token_path)
+    else:
+        print(
+            f"[run] no cached token states at {token_path}; using random states "
+            "(smoke mode — precompute with fedrec_tpu.models.bert for real runs)",
+            file=sys.stderr,
+        )
+        token_states = np.random.default_rng(0).standard_normal(
+            (data.num_news, data.title_len, cfg.model.bert_hidden)
+        ).astype(np.float32)
+
+    if args.dp_epsilon > 0:
+        cfg.privacy.enabled = True
+        cfg.privacy.epsilon = args.dp_epsilon
+        if cfg.model.text_encoder_mode == "table":
+            # decoupled path: reference-parity noise-only mechanism (the
+            # reference's sigma-from-Opacus + unclipped noise, client.py:87-89,
+            # 271-281 — carries no rigorous epsilon; see fedrec_tpu.privacy)
+            cfg.privacy.mechanism = "ldp_news"
+            print(
+                "[run] decoupled mode: using ldp_news (reference-parity, "
+                "no rigorous epsilon); use --mode joint for real DP-SGD",
+                file=sys.stderr,
+            )
+        n_train = max(len(data.train_samples), 1)
+        steps_per_epoch = max(
+            n_train // (cfg.fed.num_clients * cfg.data.batch_size), 1
+        )
+        q = min(1.0, cfg.data.batch_size / max(n_train // cfg.fed.num_clients, 1))
+        cfg.privacy.sigma = calibrate_sigma(
+            cfg.privacy.epsilon,
+            cfg.privacy.delta,
+            q,
+            steps_per_epoch * cfg.privacy.accountant_epochs,
+        )
+        print(
+            f"[run] DP enabled: eps={cfg.privacy.epsilon} delta={cfg.privacy.delta} "
+            f"sigma={cfg.privacy.sigma:.4f} clip={cfg.privacy.clip_norm}",
+            file=sys.stderr,
+        )
+
+    trainer = Trainer(cfg, data, token_states)
+    history = trainer.run()
+    if history and history[-1].val_metrics:
+        m = history[-1].val_metrics
+        print(
+            f"final: loss={history[-1].train_loss:.4f} "
+            f"auc={m.get('auc', float('nan')):.4f} "
+            f"ndcg10={m.get('ndcg10', float('nan')):.4f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

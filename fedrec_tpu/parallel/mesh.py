@@ -30,13 +30,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CLIENT_AXIS = "clients"
 
 
-def client_mesh(num_clients: int, axis: str = CLIENT_AXIS) -> Mesh:
+def client_mesh(num_clients: int, axis: str = CLIENT_AXIS, local: bool = True) -> Mesh:
     """1-D mesh with one slot per federated client.
+
+    ``local=True`` (default) builds the mesh from this process's addressable
+    devices — correct for single-host simulation and for the coordinator
+    deployment where each host trains its own clients and syncs over DCN.
+    ``local=False`` uses the global device list for a single-controller
+    multi-host SPMD mesh (all hosts must then feed globally-sharded arrays).
 
     Requires ``num_clients`` <= available devices; on CPU test rigs use
     ``--xla_force_host_platform_device_count``.
     """
-    devices = jax.devices()
+    devices = jax.local_devices() if local else jax.devices()
     if num_clients > len(devices):
         raise ValueError(
             f"num_clients={num_clients} exceeds {len(devices)} available devices; "

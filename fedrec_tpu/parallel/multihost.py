@@ -1,0 +1,113 @@
+"""Multi-host rendezvous + coordinator round control over DCN.
+
+Replaces the reference's entire hub-and-spoke deployment plumbing:
+
+  * torchrun c10d rendezvous (``--rdzv-backend=c10d --rdzv-endpoint=...``,
+    reference ``README.md:27-46``) -> ``jax.distributed.initialize``.
+  * Server weight broadcast per round (``server.py:74-77`` broadcasting every
+    parameter tensor from rank 1) -> one
+    ``multihost_utils.broadcast_one_to_all`` of the whole parameter pytree.
+  * Client -> server full ``state_dict`` streamed over raw TCP sockets in
+    1 KB chunks, ~268 MB/client/round (``client.py:191-210``,
+    ``server.py:80-98``, Final_Report.pdf VII.b) -> ``process_allgather``:
+    arrays are natively exchangeable through XLA's collectives, so the file
+    side channel (an artifact of gloo's tensor-only API) simply disappears —
+    and only the ~2M trainable params travel, never the frozen trunk.
+  * The 1.0/0.0 continue/stop flag broadcast (``server.py:74,105``,
+    ``client.py:256-258``) -> ``broadcast_round_flag``.
+
+Fault tolerance: ``aggregate_from_hosts`` takes a participation weight per
+process, so a round aggregates over whichever clients reported — the
+reference instead hangs until its 2-day gloo timeout if any client dies
+(``client.py:227``, Final_Report.pdf VII.a).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Join the multi-host world; returns (process_id, num_processes).
+
+    All arguments default to cluster auto-detection (TPU pod metadata); set
+    them explicitly for manual bring-up, e.g. CPU-based integration tests.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index(), jax.process_count()
+
+
+def broadcast_params(params: Any, is_source: bool | None = None) -> Any:
+    """Server -> all clients weight fan-out (reference ``server.py:76-77``)."""
+    return multihost_utils.broadcast_one_to_all(params, is_source=is_source)
+
+
+def broadcast_round_flag(keep_going: bool) -> bool:
+    """Continue/stop control flag (reference ``server.py:74,105``)."""
+    flag = multihost_utils.broadcast_one_to_all(
+        jnp.asarray(1.0 if keep_going else 0.0)
+    )
+    return bool(float(flag) != 0.0)
+
+
+def aggregate_from_hosts(params: Any, weight: float = 1.0) -> Any:
+    """Participation-weighted FedAvg across processes.
+
+    Each process contributes its local parameter pytree with ``weight``
+    (0 = this client sat the round out). Every process receives the
+    aggregate — the allgather-based replacement for the server's
+    TCP-gather + key-wise mean (``server.py:37-55,102``).
+    """
+    weighted = jax.tree_util.tree_map(lambda p: np.asarray(p) * weight, params)
+    gathered = multihost_utils.process_allgather(weighted)  # leading axis = process
+    weights = multihost_utils.process_allgather(np.asarray(weight, np.float32))
+    total = float(np.sum(weights))
+    if total == 0.0:
+        return params  # nobody reported; keep local (no NaNs)
+    return jax.tree_util.tree_map(lambda g: jnp.asarray(np.sum(g, axis=0) / total), gathered)
+
+
+class CoordinatorRuntime:
+    """Host-level round loop for the true client/server deployment.
+
+    Process 0 acts as the aggregation server (the reference uses global rank
+    1 as the source, ``client.py:257`` — an arbitrary choice; we use 0).
+    Single-process fallback: all methods degrade to no-ops so the same
+    driver script runs standalone.
+    """
+
+    def __init__(self):
+        self.process_id = jax.process_index()
+        self.num_processes = jax.process_count()
+
+    @property
+    def is_server(self) -> bool:
+        return self.process_id == 0
+
+    def start_round(self, round_idx: int, total_rounds: int) -> bool:
+        if self.num_processes == 1:
+            return round_idx < total_rounds
+        return broadcast_round_flag(round_idx < total_rounds)
+
+    def sync_from_server(self, params: Any) -> Any:
+        if self.num_processes == 1:
+            return params
+        return broadcast_params(params, is_source=self.is_server)
+
+    def aggregate(self, params: Any, participated: bool = True) -> Any:
+        if self.num_processes == 1:
+            return params
+        return aggregate_from_hosts(params, 1.0 if participated else 0.0)

@@ -1,0 +1,21 @@
+from fedrec_tpu.privacy.accountant import (
+    calibrate_sigma,
+    compute_epsilon,
+    compute_rdp_subsampled_gaussian,
+)
+from fedrec_tpu.privacy.dpsgd import (
+    clip_by_global_norm_per_example,
+    make_ldp_news_noise_fn,
+    make_noise_fn,
+    per_example_clipped_grads,
+)
+
+__all__ = [
+    "calibrate_sigma",
+    "clip_by_global_norm_per_example",
+    "compute_epsilon",
+    "compute_rdp_subsampled_gaussian",
+    "make_ldp_news_noise_fn",
+    "make_noise_fn",
+    "per_example_clipped_grads",
+]

@@ -45,7 +45,7 @@ from fedrec_tpu.eval.metrics import ranking_metrics_batch
 from fedrec_tpu.fed.strategies import FedStrategy, ParamAvg
 from fedrec_tpu.models import NewsRecommender, score_loss
 from fedrec_tpu.models.recommender import score_candidates
-from fedrec_tpu.parallel.mesh import CLIENT_AXIS
+from fedrec_tpu.privacy.dpsgd import make_noise_fn, per_example_clipped_grads
 from fedrec_tpu.train.state import ClientState, make_optimizers
 
 
@@ -139,33 +139,83 @@ def build_fed_train_step(
 
     ``noise_fn(grads, rng) -> grads`` is the LDP hook: applied per client,
     device-side, *before* any cross-client collective (the honest version of
-    reference ``client.py:87-89``).
+    reference ``client.py:87-89``). When None and ``cfg.privacy.enabled``, it
+    is built from the config; with ``mechanism='dpsgd'`` the joint path
+    additionally switches to per-example clipped gradients.
     """
     mode = mode or ("joint" if cfg.model.text_encoder_mode != "table" else "decoupled")
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
     axis = cfg.fed.mesh_axis
+    if noise_fn is None and cfg.privacy.enabled:
+        noise_fn = make_noise_fn(cfg.privacy, cfg.data.batch_size)
+    use_dpsgd = cfg.privacy.enabled and cfg.privacy.mechanism == "dpsgd"
+    if use_dpsgd and mode != "joint":
+        # decoupled mode has no per-example clipping path yet; noising
+        # unclipped grads with a DP-SGD-calibrated sigma would claim an
+        # (epsilon, delta) guarantee that does not hold
+        raise ValueError(
+            "mechanism='dpsgd' requires mode='joint'; use mechanism='ldp_news' "
+            "(reference-parity noise, no rigorous epsilon) for decoupled mode"
+        )
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
 
         if mode == "joint":
+            if use_dpsgd:
+                # DP-SGD: per-example grads, clipped to C, averaged; each
+                # example encodes its own C+H news directly (no cross-example
+                # dedup — it would couple examples and break the per-example
+                # sensitivity bound; and within one example unique() saves
+                # nothing, so gather + encode is the cheapest form)
+                def per_example_loss(packed, cand_row, his_row, label, ex_rng):
+                    user_params, news_params = packed
+                    c = cand_row.shape[0]
+                    ids = jnp.concatenate([cand_row, his_row])
+                    vecs = model.apply(
+                        {"params": {"text_head": news_params}},
+                        table[ids],
+                        method=NewsRecommender.encode_news,
+                    )
+                    scores = model.apply(
+                        {"params": {"user_encoder": user_params}},
+                        vecs[:c][None],
+                        vecs[c:][None],
+                        train=True,
+                        rngs={"dropout": ex_rng},
+                    )
+                    return score_loss(
+                        scores, label[None], cfg.model.sigmoid_before_ce
+                    )
 
-            def loss_fn(user_params, news_params):
-                cand_vecs, his_vecs = _batch_news_vecs(
-                    model, news_params, table, batch["candidates"], batch["history"]
+                b = batch["labels"].shape[0]
+                ex_rngs = jax.random.split(dropout_rng, b)
+                loss, (user_g, news_g) = per_example_clipped_grads(
+                    per_example_loss,
+                    (state.user_params, state.news_params),
+                    (batch["candidates"], batch["history"], batch["labels"], ex_rngs),
+                    cfg.privacy.clip_norm,
                 )
-                scores = model.apply(
-                    {"params": {"user_encoder": user_params}},
-                    cand_vecs,
-                    his_vecs,
-                    train=True,
-                    rngs={"dropout": dropout_rng},
-                )
-                return score_loss(scores, batch["labels"], cfg.model.sigmoid_before_ce)
+            else:
 
-            loss, (user_g, news_g) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                state.user_params, state.news_params
-            )
+                def loss_fn(user_params, news_params):
+                    cand_vecs, his_vecs = _batch_news_vecs(
+                        model, news_params, table, batch["candidates"], batch["history"]
+                    )
+                    scores = model.apply(
+                        {"params": {"user_encoder": user_params}},
+                        cand_vecs,
+                        his_vecs,
+                        train=True,
+                        rngs={"dropout": dropout_rng},
+                    )
+                    return score_loss(
+                        scores, batch["labels"], cfg.model.sigmoid_before_ce
+                    )
+
+                loss, (user_g, news_g) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                    state.user_params, state.news_params
+                )
             if noise_fn is not None:
                 user_g, news_g = noise_fn((user_g, news_g), noise_rng)
             user_g = strategy.sync_grads(user_g, axis)

@@ -1,0 +1,274 @@
+"""The single Trainer — ends the reference's 4-way copy-paste.
+
+The reference duplicates its Trainer + train step + grad processors across
+``main.py:95-139``, ``Gradient_Averaging_main.py:96-149``,
+``Parameter_Averaging_main.py:96-151`` and ``client.py:105-189`` with small
+diffs (SURVEY.md section 1, "Key structural fact"). Here one Trainer drives
+every mode; the differences are a ``FedStrategy`` object and config flags.
+
+Round structure (generalizes all reference drivers):
+
+  for round in rounds:                      # server.py:72 round loop
+      draw participation mask               # fixes Final_Report VII.a dropout
+      for local_epoch in local_epochs:      # client local training
+          for batch in sharded batches:     # jitted SPMD step, ICI collectives
+              step()
+          if decoupled: news_update()       # model.py:66-90 update() parity
+      if strategy.sync_params_every_round:
+          param_sync(mask)                  # Parameter_Averaging_main.py:144-148
+      evaluate(); log; snapshot every save_every  # main.py:138-139
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.data.batcher import IndexedSamples, TrainBatcher, index_samples
+from fedrec_tpu.data.mind import MindData
+from fedrec_tpu.fed.strategies import get_strategy
+from fedrec_tpu.models import NewsRecommender
+from fedrec_tpu.parallel.mesh import client_mesh, client_sharding, shard_batch
+from fedrec_tpu.train.checkpoint import SnapshotManager
+from fedrec_tpu.train.state import init_client_state, replicate_state
+from fedrec_tpu.train.step import (
+    build_eval_step,
+    build_fed_train_step,
+    build_news_update_step,
+    build_param_sync,
+    encode_all_news,
+)
+from fedrec_tpu.utils.logging import MetricLogger
+from fedrec_tpu.utils.profiling import profile_if
+
+
+@dataclass
+class RoundResult:
+    round_idx: int
+    train_loss: float
+    val_metrics: dict[str, float] = field(default_factory=dict)
+
+
+class Trainer:
+    """Federated trainer over a clients mesh.
+
+    ``token_states``: (N_news, L, bert_hidden) cached frozen-trunk token
+    states (see ``fedrec_tpu.models.bert`` for producing them from a real
+    DistilBERT, or pass synthetic states for smoke runs).
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        data: MindData,
+        token_states: np.ndarray,
+        snapshot_dir: str | None = None,
+    ):
+        self.cfg = cfg
+        self.data = data
+        self.model = NewsRecommender(cfg.model)
+        self.strategy = get_strategy(cfg.fed.strategy)
+        self.mesh = client_mesh(cfg.fed.num_clients, cfg.fed.mesh_axis)
+        self.mode = "joint" if cfg.model.text_encoder_mode != "table" else "decoupled"
+
+        self.token_states = jnp.asarray(token_states, dtype=jnp.dtype(cfg.model.dtype))
+
+        train_ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
+        self.batcher = TrainBatcher(
+            train_ix,
+            cfg.data.batch_size,
+            cfg.data.npratio,
+            shuffle=cfg.data.shuffle,
+            drop_remainder=cfg.data.drop_remainder,
+            seed=cfg.data.seed,
+        )
+        self.valid_ix: IndexedSamples | None = None
+        if data.valid_samples:
+            self.valid_ix = index_samples(
+                data.valid_samples, data.nid2index, cfg.data.max_his_len
+            )
+
+        # jitted programs
+        self.train_step = build_fed_train_step(
+            self.model, cfg, self.strategy, self.mesh, mode=self.mode
+        )
+        self.news_update = build_news_update_step(
+            self.model, cfg, self.mesh, self.strategy
+        )
+        self.param_sync = build_param_sync(cfg, self.mesh, self.strategy)
+        self.eval_step = build_eval_step(self.model, cfg)
+
+        # state (pre-sharded so the first step doesn't retrace)
+        state0 = init_client_state(
+            self.model,
+            cfg,
+            jax.random.PRNGKey(cfg.train.seed),
+            data.num_news,
+            data.title_len,
+        )
+        stacked = replicate_state(
+            state0, cfg.fed.num_clients, jax.random.PRNGKey(cfg.train.seed + 1)
+        )
+        sharding = client_sharding(self.mesh, cfg.fed.mesh_axis)
+        self.state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), stacked
+        )
+
+        self.start_round = 0
+        self.snapshots: SnapshotManager | None = None
+        if snapshot_dir or cfg.train.snapshot_dir:
+            self.snapshots = SnapshotManager(snapshot_dir or cfg.train.snapshot_dir)
+            if cfg.train.resume and self.snapshots.latest_round() is not None:
+                self.state = self.snapshots.restore(self.state)
+                self.start_round = int(self.snapshots.latest_round()) + 1
+                print(f"[trainer] resumed from snapshot at round {self.start_round - 1}")
+
+        self.logger = MetricLogger(
+            use_wandb=cfg.train.wandb,
+            project=cfg.train.wandb_project,
+            run_name=cfg.train.run_name,
+        )
+        self._table: jnp.ndarray | None = None  # decoupled-mode news-vec table
+
+    # ------------------------------------------------------------------
+    def _client0_params(self) -> tuple[Any, Any]:
+        u = jax.tree_util.tree_map(lambda x: x[0], self.state.user_params)
+        n = jax.tree_util.tree_map(lambda x: x[0], self.state.news_params)
+        return u, n
+
+    def set_global_params(self, user_params: Any, news_params: Any) -> None:
+        """Adopt externally-aggregated parameters on every local client.
+
+        Used by the coordinator deployment: the server's weight fan-out
+        (reference ``server.py:76-77`` / ``client.py:261-264``) lands here.
+        """
+        n = self.cfg.fed.num_clients
+        bcast = lambda x: jnp.broadcast_to(x, (n,) + x.shape)  # noqa: E731
+        self.state = self.state.replace(
+            user_params=jax.tree_util.tree_map(bcast, user_params),
+            news_params=jax.tree_util.tree_map(bcast, news_params),
+        )
+        if self.mode == "decoupled":
+            self._refresh_table()
+
+    def _refresh_table(self) -> jnp.ndarray:
+        _, news_params = self._client0_params()
+        self._table = encode_all_news(self.model, news_params, self.token_states)
+        return self._table
+
+    def _feature_table(self) -> jnp.ndarray:
+        if self.mode == "joint":
+            return self.token_states
+        if self._table is None:
+            self._refresh_table()
+        return self._table
+
+    # ------------------------------------------------------------------
+    def train_round(self, round_idx: int) -> RoundResult:
+        cfg = self.cfg
+        mask_rng = jax.random.PRNGKey(hash((cfg.train.seed, round_idx)) & 0x7FFFFFFF)
+        from fedrec_tpu.fed.strategies import participation_mask
+
+        weights = participation_mask(
+            mask_rng, cfg.fed.num_clients, cfg.fed.participation
+        )
+
+        losses = []
+        for local_epoch in range(cfg.fed.local_epochs):
+            epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
+            table = self._feature_table()
+            for batch in self.batcher.epoch_batches_sharded(
+                cfg.fed.num_clients, epoch_idx
+            ):
+                sharded = shard_batch(
+                    self.mesh,
+                    {
+                        "candidates": batch.candidates,
+                        "history": batch.history,
+                        "labels": batch.labels,
+                    },
+                    cfg.fed.mesh_axis,
+                )
+                self.state, metrics = self.train_step(self.state, sharded, table)
+                losses.append(metrics["mean_loss"])
+            if self.mode == "decoupled":
+                self.state, tables = self.news_update(self.state, self.token_states)
+                self._table = jax.tree_util.tree_map(lambda x: x[0], tables)
+
+        if self.strategy.sync_params_every_round:
+            self.state = self.param_sync(self.state, weights)
+            if self.mode == "decoupled":
+                self._refresh_table()
+
+        train_loss = float(np.mean([np.mean(np.asarray(l)) for l in losses]))
+        result = RoundResult(round_idx, train_loss)
+        if self.valid_ix is not None and (round_idx + 1) % self.cfg.train.eval_every == 0:
+            result.val_metrics = self.evaluate()
+        return result
+
+    def evaluate(self) -> dict[str, float]:
+        """Mean validation metrics over all impressions (fixes the reference's
+        last-sample-only bug, ``client.py:171``) using client-0 parameters
+        (identical across clients after a sync round)."""
+        assert self.valid_ix is not None, "no validation samples"
+        user_params, news_params = self._client0_params()
+        table = encode_all_news(self.model, news_params, self.token_states)
+        vb = TrainBatcher(
+            self.valid_ix,
+            batch_size=min(len(self.valid_ix), 256),
+            npratio=self.cfg.data.npratio,
+            shuffle=False,
+            drop_remainder=False,
+            seed=0,
+        )
+        sums: dict[str, float] = {}
+        count = 0
+        for batch in vb.epoch_batches(0):
+            out = self.eval_step(
+                user_params,
+                table,
+                {
+                    "candidates": batch.candidates,
+                    "history": batch.history,
+                    "labels": batch.labels,
+                },
+            )
+            bsz = batch.candidates.shape[0]
+            for k, v in out.items():
+                sums[k] = sums.get(k, 0.0) + float(v) * bsz
+            count += bsz
+        return {k: v / count for k, v in sums.items()}
+
+    # ------------------------------------------------------------------
+    def run(self) -> list[RoundResult]:
+        cfg = self.cfg
+        history: list[RoundResult] = []
+        with profile_if(cfg.train.profile):
+            for round_idx in range(self.start_round, cfg.fed.rounds):
+                result = self.train_round(round_idx)
+                history.append(result)
+                log = {"round": round_idx, "training_loss": result.train_loss}
+                if result.val_metrics:
+                    log.update(
+                        {
+                            "validation_loss": result.val_metrics.get("loss"),
+                            "valid_auc": result.val_metrics.get("auc"),
+                            "valid_mrr": result.val_metrics.get("mrr"),
+                            "val_ndcg@5": result.val_metrics.get("ndcg5"),
+                            "val_ndcg@10": result.val_metrics.get("ndcg10"),
+                        }
+                    )
+                self.logger.log(round_idx, log)
+                if self.snapshots is not None and (
+                    (round_idx + 1) % cfg.train.save_every == 0
+                    or round_idx == cfg.fed.rounds - 1
+                ):
+                    self.snapshots.save(round_idx, self.state)
+        self.logger.finish()
+        return history

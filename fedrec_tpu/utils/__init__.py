@@ -1,0 +1,4 @@
+from fedrec_tpu.utils.logging import MetricLogger
+from fedrec_tpu.utils.profiling import profile_if
+
+__all__ = ["MetricLogger", "profile_if"]

@@ -1,0 +1,48 @@
+"""Metric logging: stdout always, wandb when available and enabled.
+
+Mirrors the reference's 6-metric wandb schema (train/valid loss + AUC/MRR/
+NDCG@5/NDCG@10, reference ``client.py:182-189``) without the hardcoded API
+key (``client.py:214`` — a leaked secret we deliberately do not replicate;
+auth comes from the environment).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any
+
+
+class MetricLogger:
+    def __init__(
+        self,
+        use_wandb: bool = False,
+        project: str = "fedrec_tpu",
+        run_name: str = "run",
+        stream=None,
+    ):
+        self.stream = stream or sys.stdout
+        self._t0 = time.time()
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb  # noqa: PLC0415
+
+                wandb.init(project=project, name=run_name)
+                self._wandb = wandb
+            except Exception as e:  # wandb missing or offline — degrade to stdout
+                print(f"[logger] wandb unavailable ({e}); stdout only", file=sys.stderr)
+
+    def log(self, step: int, metrics: dict[str, Any]) -> None:
+        clean = {
+            k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()
+        }
+        record = {"step": step, "elapsed_sec": round(time.time() - self._t0, 2), **clean}
+        print(json.dumps(record), file=self.stream)
+        if self._wandb is not None:
+            self._wandb.log(clean, step=step)
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -1,0 +1,24 @@
+"""Profiling helpers: ``jax.profiler`` traces around the hot loop.
+
+The reference has no profiling subsystem (SURVEY.md section 5.1 — only print
+statements and a vestigial counter pair, reference ``model.py:31-32``); here
+a context manager wraps any region in a TensorBoard-compatible trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_if(enabled: bool, logdir: str = "/tmp/fedrec_tpu_trace"):
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
