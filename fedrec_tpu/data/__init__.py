@@ -7,15 +7,35 @@ from fedrec_tpu.data.batcher import (
     index_samples,
     shard_indices,
 )
+from fedrec_tpu.data.preprocess import (
+    build_news_index,
+    parse_behaviors_tsv,
+    parse_news_tsv,
+    preprocess_mind,
+    write_artifacts,
+)
+from fedrec_tpu.data.tokenizer import (
+    HashingTokenizer,
+    WordPieceTokenizer,
+    get_tokenizer,
+)
 
 __all__ = [
     "Batch",
+    "HashingTokenizer",
     "IndexedSamples",
     "MindData",
     "TrainBatcher",
+    "WordPieceTokenizer",
+    "build_news_index",
+    "get_tokenizer",
     "index_samples",
     "load_mind_artifacts",
     "make_synthetic_mind",
     "newsample",
+    "parse_behaviors_tsv",
+    "parse_news_tsv",
+    "preprocess_mind",
     "shard_indices",
+    "write_artifacts",
 ]

@@ -1,0 +1,127 @@
+"""DistilBERT trunk: parity vs the torch implementation + precompute paths.
+
+The reference's text trunk is HF torch ``DistilBertModel`` (reference
+``encoder.py:19``). We verify our Flax re-implementation is numerically
+identical by instantiating a TINY random torch DistilBERT offline, converting
+its state_dict, and comparing per-token hidden states.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedrec_tpu.models.bert import (
+    DistilBert,
+    DistilBertConfig,
+    TextEncoder,
+    convert_hf_state_dict,
+    init_trunk_params,
+    precompute_token_states,
+)
+
+TINY = DistilBertConfig(
+    vocab_size=97,
+    max_position_embeddings=32,
+    dim=24,
+    n_layers=2,
+    n_heads=3,
+    hidden_dim=48,
+    dropout=0.0,
+    attention_dropout=0.0,
+)
+
+
+def _tiny_torch_model():
+    torch = pytest.importorskip("torch")
+    from transformers import DistilBertConfig as HFConfig, DistilBertModel
+
+    hf_cfg = HFConfig(
+        vocab_size=TINY.vocab_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        dim=TINY.dim,
+        n_layers=TINY.n_layers,
+        n_heads=TINY.n_heads,
+        hidden_dim=TINY.hidden_dim,
+        dropout=0.0,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    return DistilBertModel(hf_cfg).eval()
+
+
+def test_trunk_matches_torch_distilbert(rng):
+    torch = pytest.importorskip("torch")
+    hf = _tiny_torch_model()
+    params = convert_hf_state_dict(hf.state_dict(), TINY)
+
+    B, L = 4, 12
+    ids = rng.integers(0, TINY.vocab_size, size=(B, L)).astype(np.int64)
+    mask = np.ones((B, L), np.int64)
+    mask[0, 8:] = 0  # one padded row exercises the attention bias
+    mask[2, 5:] = 0
+
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+        ).last_hidden_state.numpy()
+
+    got = DistilBert(TINY).apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+
+
+def test_convert_accepts_prefixed_keys():
+    hf = _tiny_torch_model()
+    prefixed = {f"distilbert.{k}": v for k, v in hf.state_dict().items()}
+    params = convert_hf_state_dict(prefixed, TINY)
+    assert "layer_1" in params and "word_embeddings" in params
+
+
+def test_precompute_token_states_matches_direct(rng):
+    params = init_trunk_params(jax.random.PRNGKey(0), TINY, title_len=10)
+    n, L = 13, 10  # non-divisible by chunk -> exercises the pad path
+    tokens = np.zeros((n, 2, L), np.int64)
+    tokens[:, 0] = rng.integers(0, TINY.vocab_size, size=(n, L))
+    tokens[:, 1] = 1
+    tokens[3, 1, 6:] = 0
+
+    states = precompute_token_states(params, tokens, TINY, chunk=4)
+    assert states.shape == (n, L, TINY.dim)
+
+    direct = DistilBert(TINY).apply(
+        {"params": params},
+        jnp.asarray(tokens[:, 0], jnp.int32),
+        jnp.asarray(tokens[:, 1], jnp.int32),
+    )
+    np.testing.assert_allclose(states, np.asarray(direct), atol=1e-5)
+
+
+def test_text_encoder_end_to_end_shapes(rng):
+    model = TextEncoder(trunk_cfg=TINY, news_dim=16)
+    tokens = np.zeros((3, 5, 2, 10), np.int64)  # (B, C, 2, L)
+    tokens[..., 0, :] = rng.integers(0, TINY.vocab_size, size=(3, 5, 10))
+    tokens[..., 1, :] = 1
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+    vecs = model.apply(variables, jnp.asarray(tokens))
+    assert vecs.shape == (3, 5, 16)
+    assert np.isfinite(np.asarray(vecs)).all()
+
+
+def test_text_encoder_grads_flow_through_trunk(rng):
+    model = TextEncoder(trunk_cfg=TINY, news_dim=16, remat=True)
+    tokens = np.zeros((2, 2, 10), np.int64)
+    tokens[:, 0] = rng.integers(0, TINY.vocab_size, size=(2, 10))
+    tokens[:, 1] = 1
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+
+    def loss(params):
+        return jnp.sum(model.apply({"params": params}, jnp.asarray(tokens)) ** 2)
+
+    grads = jax.grad(loss)(variables["params"])
+    leaves = jax.tree_util.tree_leaves(grads["trunk"])
+    norms = [float(jnp.linalg.norm(g)) for g in leaves]
+    assert any(nrm > 0 for nrm in norms)  # trunk actually receives gradient
+    assert all(np.isfinite(nrm) for nrm in norms)
