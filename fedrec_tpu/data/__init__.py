@@ -7,6 +7,7 @@ from fedrec_tpu.data.batcher import (
     index_samples,
     shard_indices,
 )
+from fedrec_tpu.data.adressa import parse_adressa_events, preprocess_adressa
 from fedrec_tpu.data.preprocess import (
     build_news_index,
     parse_behaviors_tsv,
@@ -33,7 +34,9 @@ __all__ = [
     "load_mind_artifacts",
     "make_synthetic_mind",
     "newsample",
+    "parse_adressa_events",
     "parse_behaviors_tsv",
+    "preprocess_adressa",
     "parse_news_tsv",
     "preprocess_mind",
     "shard_indices",

@@ -75,16 +75,20 @@ def parse_behaviors_tsv(
     path: str | Path,
     known_nids: set[str],
     max_his_len: int | None = None,
+    uid2idx: dict[str, int] | None = None,
 ) -> list:
     """behaviors.tsv -> ``[uidx, pos, neg_pool, history, uid]`` per click.
 
     Unknown nids (not in ``news.tsv``) are dropped from histories and pools;
     a click on an unknown nid is skipped entirely. ``max_his_len`` optionally
     pre-truncates histories to the most recent clicks (the batcher truncates
-    again regardless — ledger note at ``fedrec_tpu.data.batcher``).
+    again regardless — ledger note at ``fedrec_tpu.data.batcher``). Pass one
+    shared ``uid2idx`` across train/valid calls so a given uidx means the
+    same user in both artifacts.
     """
     samples: list = []
-    uid2idx: dict[str, int] = {}
+    if uid2idx is None:
+        uid2idx = {}
     with open(path, encoding="utf-8") as f:
         for line in f:
             parts = line.rstrip("\n").split("\t")
@@ -122,9 +126,12 @@ def preprocess_mind(
     titles = parse_news_tsv(news_path)
     news_tokens, nid2index = build_news_index(titles, tokenizer, max_title_len)
     known = set(titles)
-    train_samples = parse_behaviors_tsv(train_behaviors, known)
+    uid2idx: dict[str, int] = {}  # shared: uidx must mean one user across splits
+    train_samples = parse_behaviors_tsv(train_behaviors, known, uid2idx=uid2idx)
     valid_samples = (
-        parse_behaviors_tsv(valid_behaviors, known) if valid_behaviors else []
+        parse_behaviors_tsv(valid_behaviors, known, uid2idx=uid2idx)
+        if valid_behaviors
+        else []
     )
     data = MindData(news_tokens, nid2index, train_samples, valid_samples)
     if out_dir is not None:

@@ -42,19 +42,37 @@ def _masked_normalize(
 
 
 class AdditiveAttention(nn.Module):
-    """Learned-query additive pooling over a sequence: (..., L, D) -> (..., D)."""
+    """Learned-query additive pooling over a sequence: (..., L, D) -> (..., D).
+
+    ``use_pallas=True`` routes through the fused VMEM kernel
+    (``fedrec_tpu.ops.additive_pool``); requires ``stable_softmax`` (the
+    kernel computes a true softmax — the fc2 bias, a softmax-invariant
+    constant shift, is omitted there; its gradient is exactly zero either
+    way). Falls back to the jnp path otherwise.
+    """
 
     hidden: int = 200
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(
         self, x: jnp.ndarray, mask: jnp.ndarray | None = None
     ) -> jnp.ndarray:
-        e = nn.Dense(self.hidden, dtype=self.dtype, name="att_fc1")(x)
-        e = jnp.tanh(e)
-        logits = nn.Dense(1, dtype=self.dtype, name="att_fc2")(e)[..., 0]  # (..., L)
+        fc1 = nn.Dense(self.hidden, dtype=self.dtype, name="att_fc1")
+        fc2 = nn.Dense(1, dtype=self.dtype, name="att_fc2")
+        if self.use_pallas and self.stable_softmax:
+            from fedrec_tpu.ops import additive_pool
+
+            # zero-length calls create the (identical) param tree; XLA DCEs them
+            fc2(fc1(x[..., :0, :]))
+            p1, p2 = fc1.variables["params"], fc2.variables["params"]
+            return additive_pool(
+                x, p1["kernel"], p1["bias"], p2["kernel"][:, 0], mask
+            )
+        e = jnp.tanh(fc1(x))
+        logits = fc2(e)[..., 0]  # (..., L)
         if mask is not None:
             mask = mask.astype(logits.dtype)
         alpha = _masked_normalize(logits, mask, axis=-1, stable=self.stable_softmax)
@@ -75,6 +93,7 @@ class MultiHeadAttention(nn.Module):
     head_dim: int = 20
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(
@@ -99,6 +118,13 @@ class MultiHeadAttention(nn.Module):
         q_s = split_heads(dense("w_q")(q))  # (..., L, H, Dk)
         k_s = split_heads(dense("w_k")(k))
         v_s = split_heads(dense("w_v")(v))
+
+        if self.use_pallas and self.stable_softmax:
+            # blocked online-softmax kernel: no (..., H, L, L) score tensor
+            from fedrec_tpu.ops import flash_attention
+
+            context = flash_attention(q_s, k_s, v_s, mask)
+            return context.reshape(*batch, L, d)
 
         scores = jnp.einsum("...qhd,...khd->...hqk", q_s, k_s) / jnp.sqrt(
             jnp.asarray(self.head_dim, dtype=q_s.dtype)

@@ -29,7 +29,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax import lax
 
 
 @dataclass(frozen=True)
@@ -226,7 +225,9 @@ def precompute_token_states(
     def run(p, ids, mask):
         return model.apply({"params": p}, ids, mask)
 
-    out = []
+    # preallocate: a chunk-list + concatenate would transiently double the
+    # footprint of an already-large array (MIND-large: ~15 GB at float32)
+    out = np.empty((n, news_tokens.shape[2], cfg.dim), dtype=dtype)
     for start in range(0, n, chunk):
         block = news_tokens[start : start + chunk]
         ids = jnp.asarray(block[:, 0], jnp.int32)
@@ -236,8 +237,8 @@ def precompute_token_states(
             ids = jnp.pad(ids, ((0, pad), (0, 0)))
             mask = jnp.pad(mask, ((0, pad), (0, 0)))
         states = run(params, ids, mask)
-        out.append(np.asarray(states[: block.shape[0]]))
-    return np.concatenate(out, axis=0)
+        out[start : start + block.shape[0]] = np.asarray(states[: block.shape[0]])
+    return out
 
 
 def init_trunk_params(

@@ -30,6 +30,7 @@ class TextHead(nn.Module):
     bert_hidden: int = 768
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(
@@ -41,6 +42,7 @@ class TextHead(nn.Module):
             hidden=self.bert_hidden // 2,
             stable_softmax=self.stable_softmax,
             dtype=self.dtype,
+            use_pallas=self.use_pallas,
             name="pool",
         )(token_states, mask)
         return nn.Dense(self.news_dim, dtype=self.dtype, name="fc")(pooled)
@@ -56,6 +58,7 @@ class UserEncoder(nn.Module):
     dropout_rate: float = 0.2
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(
@@ -70,11 +73,13 @@ class UserEncoder(nn.Module):
             head_dim=self.head_dim,
             stable_softmax=self.stable_softmax,
             dtype=self.dtype,
+            use_pallas=self.use_pallas,
             name="self_attn",
         )(x, x, x, mask)
         return AdditiveAttention(
             hidden=self.query_dim,
             stable_softmax=self.stable_softmax,
             dtype=self.dtype,
+            use_pallas=self.use_pallas,
             name="pool",
         )(x, mask)

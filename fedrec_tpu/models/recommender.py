@@ -61,6 +61,7 @@ class NewsRecommender(nn.Module):
             bert_hidden=self.cfg.bert_hidden,
             stable_softmax=self.cfg.stable_softmax,
             dtype=dtype,
+            use_pallas=self.cfg.use_pallas,
         )
         self.user_encoder = UserEncoder(
             news_dim=self.cfg.news_dim,
@@ -70,6 +71,7 @@ class NewsRecommender(nn.Module):
             dropout_rate=self.cfg.dropout_rate,
             stable_softmax=self.cfg.stable_softmax,
             dtype=dtype,
+            use_pallas=self.cfg.use_pallas,
         )
 
     def encode_news(

@@ -1,0 +1,20 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's compute kernels are whatever cuDNN/MKL ships inside the torch
+wheel (SURVEY.md section 2: zero native sources in-repo). Here the hot ops get
+first-class TPU kernels:
+
+  * ``flash_attention`` — blocked online-softmax attention (the user encoder's
+    self-attention over click histories; keeps long histories O(L) in VMEM
+    instead of materializing the (heads, L, L) score tensor the reference
+    allocates, reference ``attention.py:38``).
+  * ``additive_pool`` — fused learned-query additive pooling (tanh-MLP scores
+    + softmax + weighted sum in one VMEM pass; reference ``attention.py:14-26``).
+
+Both run in Pallas interpret mode on CPU (tests) and compiled on TPU, and are
+routed from the Flax modules via ``ModelConfig.use_pallas``.
+"""
+
+from fedrec_tpu.ops.attention_kernels import additive_pool, flash_attention
+
+__all__ = ["additive_pool", "flash_attention"]

@@ -1,0 +1,309 @@
+"""Fused attention kernels (Pallas, TPU).
+
+Two kernels cover the recommender's attention math (reference
+``attention.py``):
+
+  * ``flash_attention``: multi-head scaled-dot-product attention with online
+    softmax — never materializes the (L, L) score matrix. The reference
+    allocates dense ``(bz, heads, L, L)`` scores (``attention.py:38-44``);
+    fine at L=50, fatal for long histories. Numerics match the model's
+    ``stable_softmax=True`` path; an optional key mask reproduces the
+    multiply-after-exp masking up to its 1e-8 epsilon.
+  * ``additive_pool``: learned-query additive pooling
+    ``softmax(tanh(x W1 + b1) w2) . x`` in one VMEM pass (reference
+    ``attention.py:14-26``).
+
+Kernels auto-fall back to interpret mode off-TPU so the same code path is
+exercised by CPU tests. Backward passes go through ``jax.custom_vjp`` with a
+dense recompute (correct, memory-light at training shapes); a blocked
+backward kernel is a future optimization.
+
+Layout notes (guide: /opt/skills/guides/pallas_guide.md): last dim padded to
+128 lanes, blocks padded to 8-sublane multiples, matmuls carry
+``preferred_element_type=float32`` so they hit the MXU in full precision.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_SUBLANE = 8
+_NEG_INF = -1e9
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ============================================================ flash attention
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, scale: float):
+    """One (batch*head, q-block) program: online softmax over key blocks.
+
+    q_ref: (1, block_q, dk)   k_ref/v_ref: (1, L_pad, dk)   bias: (1, 1, L_pad)
+    """
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, dk)
+    l_pad = k_ref.shape[1]
+    block_q = q.shape[0]
+    dv = v_ref.shape[2]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, dv), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        b = bias_ref[0, 0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + b[None, :]                                   # (bq, bk)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, l_pad // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray,
+    block_q: int,
+    block_k: int,
+) -> jnp.ndarray:
+    """(BH, Lq, dk) x (BH, Lk, dk) x (BH, Lk, dv) + key bias (BH, Lk) -> (BH, Lq, dv)."""
+    bh, lq, dk = q.shape
+    dv = v.shape[-1]
+    scale = 1.0 / (dk ** 0.5)
+
+    # pad to hardware tiles; padded keys are masked via the bias
+    qp = _pad_to(_pad_to(q, 2, _LANE), 1, block_q)
+    kp = _pad_to(_pad_to(k, 2, _LANE), 1, block_k)
+    vp = _pad_to(_pad_to(v, 2, _LANE), 1, block_k)
+    biasp = _pad_to(bias, 1, block_k)
+    if biasp.shape[1] > bias.shape[1]:
+        biasp = biasp.at[:, bias.shape[1]:].set(_NEG_INF)
+    biasp = biasp[:, None, :]                            # (BH, 1, Lk_pad)
+
+    lq_pad, lk_pad = qp.shape[1], kp.shape[1]
+    grid = (bh, lq_pad // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, vp.shape[2]), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk_pad, kp.shape[2]), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk_pad, vp.shape[2]), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, lk_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, vp.shape[2]), lambda b, i: (b, i, 0)),
+        interpret=_interpret(),
+    )(qp, kp, vp, biasp)
+    return out[:, :lq, :dv]
+
+
+def _attention_dense(q, k, v, bias):
+    """Reference dense math (also the backward recompute)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale + bias[:, None, :]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, bias, block_q, block_k):
+    return _flash_forward(q, k, v, bias, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, bias, block_q, block_k):
+    return _flash_forward(q, k, v, bias, block_q, block_k), (q, k, v, bias)
+
+
+def _flash_bwd(block_q, block_k, res, g):
+    q, k, v, bias = res
+    _, vjp = jax.vjp(_attention_dense, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Multi-head attention, (..., L, H, D) layout like the Flax module.
+
+    ``q``: (..., Lq, H, Dk); ``k``/``v``: (..., Lk, H, D); ``mask``:
+    optional (..., Lk) key mask (1 = attend). Returns (..., Lq, H, Dv).
+    """
+    *batch, lq, h, dk = q.shape
+    lk, dv = k.shape[-3], v.shape[-1]
+    bsz = 1
+    for b in batch:
+        bsz *= b
+
+    def flat(x, L, d):
+        # (..., L, H, d) -> (B*H, L, d)
+        x = x.reshape(bsz, L, h, d)
+        return x.transpose(0, 2, 1, 3).reshape(bsz * h, L, d)
+
+    qf, kf, vf = flat(q, lq, dk), flat(k, lk, dk), flat(v, lk, dv)
+    if mask is None:
+        bias = jnp.zeros((bsz * h, lk), jnp.float32)
+    else:
+        m = mask.reshape(bsz, lk).astype(jnp.float32)
+        bias = jnp.repeat(jnp.where(m > 0, 0.0, _NEG_INF), h, axis=0)
+    out = _flash(qf, kf, vf, bias, block_q, block_k)
+    if mask is not None:
+        # additive bias is shift-invariant under softmax, so a fully-masked
+        # row would attend uniformly; the module's exp*mask/(sum+eps) math
+        # (attention.py:41) returns ~0 there — match it
+        has_valid = (mask.reshape(bsz, lk).sum(-1) > 0).astype(out.dtype)
+        out = out * jnp.repeat(has_valid, h)[:, None, None]
+    out = out.reshape(bsz, h, lq, dv).transpose(0, 2, 1, 3)
+    return out.reshape(*batch, lq, h, dv)
+
+
+# ============================================================ additive pool
+def _pool_kernel(x_ref, w1_ref, b1_ref, w2_ref, bias_ref, o_ref):
+    """One row-block program: fused tanh-MLP scores + softmax + weighted sum.
+
+    x_ref: (block_n, L, D)  w1: (D, Hd)  b1: (1, Hd)  w2: (Hd, 1)
+    bias_ref: (block_n, L) additive key bias.
+    """
+    bn, L, D = x_ref.shape
+    x = x_ref[:].astype(jnp.float32)
+    flat = x.reshape(bn * L, D)
+    e = jnp.tanh(
+        jax.lax.dot_general(
+            flat, w1_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + b1_ref[0][None, :]
+    )
+    # w2 is lane-padded to (Hd, 128); only column 0 is the real query vector
+    logits = jax.lax.dot_general(
+        e, w2_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, :1].reshape(bn, L) + bias_ref[:]
+    alpha = jax.nn.softmax(logits, axis=-1)
+    pooled = jax.lax.dot_general(
+        alpha[:, None, :], x, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )[:, 0, :]
+    o_ref[:] = pooled.astype(o_ref.dtype)
+
+
+def _pool_forward(x, w1, b1, w2, bias, block_n):
+    n, L, D = x.shape
+    xp = _pad_to(_pad_to(_pad_to(x, 0, block_n), 1, _SUBLANE), 2, _LANE)
+    biasp = _pad_to(_pad_to(bias, 0, block_n), 1, _SUBLANE)
+    if biasp.shape[1] > L:  # padded sequence slots must never win the softmax
+        biasp = biasp.at[:, L:].set(_NEG_INF)
+    w1p = _pad_to(_pad_to(w1, 0, _LANE), 1, _LANE)
+    b1p = _pad_to(b1.reshape(1, -1), 1, _LANE)
+    w2p = _pad_to(_pad_to(w2.reshape(-1, 1), 0, _LANE), 1, _LANE)
+    n_pad, d_pad, h_pad = xp.shape[0], xp.shape[2], w1p.shape[1]
+
+    out = pl.pallas_call(
+        _pool_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, d_pad), x.dtype),
+        grid=(n_pad // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, xp.shape[1], d_pad), lambda i: (i, 0, 0)),
+            pl.BlockSpec((d_pad, h_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, h_pad), lambda i: (0, 0)),
+            pl.BlockSpec((h_pad, w2p.shape[1]), lambda i: (0, 0)),
+            pl.BlockSpec((block_n, xp.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, d_pad), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(xp, w1p, b1p, w2p, biasp)
+    return out[:n, :D]
+
+
+def _pool_dense(x, w1, b1, w2, bias):
+    e = jnp.tanh(jnp.einsum("nld,dh->nlh", x, w1) + b1)
+    logits = jnp.einsum("nlh,h->nl", e, w2.reshape(-1)) + bias
+    alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    return jnp.einsum("nl,nld->nd", alpha, x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _pool(x, w1, b1, w2, bias, block_n):
+    return _pool_forward(x, w1, b1, w2, bias, block_n)
+
+
+def _pool_fwd(x, w1, b1, w2, bias, block_n):
+    return _pool_forward(x, w1, b1, w2, bias, block_n), (x, w1, b1, w2, bias)
+
+
+def _pool_bwd(block_n, res, g):
+    x, w1, b1, w2, bias = res
+    _, vjp = jax.vjp(_pool_dense, x, w1, b1, w2, bias)
+    return vjp(g)
+
+
+_pool.defvjp(_pool_fwd, _pool_bwd)
+
+
+def additive_pool(
+    x: jnp.ndarray,
+    w1: jnp.ndarray,
+    b1: jnp.ndarray,
+    w2: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    block_n: int = 8,
+) -> jnp.ndarray:
+    """Fused additive-attention pooling: (..., L, D) -> (..., D).
+
+    ``w1``: (D, hidden), ``b1``: (hidden,), ``w2``: (hidden,) — the two Dense
+    layers of ``AdditiveAttention`` (reference ``attention.py:14-26``).
+    ``mask``: optional (..., L), 1 = keep.
+    """
+    *batch, L, D = x.shape
+    n = 1
+    for b in batch:
+        n *= b
+    xf = x.reshape(n, L, D)
+    if mask is None:
+        bias = jnp.zeros((n, L), jnp.float32)
+    else:
+        bias = jnp.where(mask.reshape(n, L) > 0, 0.0, _NEG_INF).astype(jnp.float32)
+    out = _pool(xf, w1, b1, w2, bias, block_n)
+    if mask is not None:
+        # fully-masked rows pool to ~0 on the jnp path (attention.py:41) —
+        # softmax shift-invariance would otherwise make them uniform here
+        has_valid = (mask.reshape(n, L).sum(-1) > 0).astype(out.dtype)
+        out = out * has_valid[:, None]
+    return out.reshape(*batch, D)

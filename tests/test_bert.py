@@ -35,7 +35,11 @@ TINY = DistilBertConfig(
 
 def _tiny_torch_model():
     torch = pytest.importorskip("torch")
-    from transformers import DistilBertConfig as HFConfig, DistilBertModel
+    transformers = pytest.importorskip("transformers")
+    HFConfig, DistilBertModel = (
+        transformers.DistilBertConfig,
+        transformers.DistilBertModel,
+    )
 
     hf_cfg = HFConfig(
         vocab_size=TINY.vocab_size,

@@ -107,6 +107,15 @@ def test_roundtrip_artifacts_and_training_batch(tsv_files, tmp_path):
     assert (batch.candidates < loaded.num_news).all()
 
 
+def test_uidx_consistent_across_splits(tsv_files, tmp_path):
+    news, behaviors = tsv_files
+    data = preprocess_mind(news, behaviors, behaviors, max_title_len=12)
+    # same behaviors file for both splits -> identical (uidx, uid) pairing
+    train_map = {s[4]: s[0] for s in data.train_samples}
+    valid_map = {s[4]: s[0] for s in data.valid_samples}
+    assert train_map == valid_map
+
+
 def test_wordpiece_matches_bert_layout(tmp_path):
     vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "new", "chip", "##s", "win", "cup"]
     vp = tmp_path / "vocab.txt"
