@@ -79,6 +79,12 @@ class FedConfig:
     rounds: int = 10                   # global rounds (server.py global_epochs)
     participation: float = 1.0         # fraction of clients aggregated per round
     mesh_axis: str = "clients"
+    # sequence/context parallelism for long click-histories: shard the history
+    # axis over `seq_shards` chips per client and attend via ring or Ulysses
+    # all-to-all collectives (fedrec_tpu.parallel.ring). 1 = off.
+    seq_shards: int = 1
+    seq_axis: str = "seq"
+    seq_impl: str = "ring"             # "ring" | "ulysses"
 
 
 @dataclass

@@ -46,11 +46,13 @@ def parse_adressa_events(
 
     Events without a news id, title, or user are skipped (the raw logs mix
     pageviews of front pages and ads with article reads). Repeated clicks by
-    the same user on the same article keep only the first occurrence.
+    the same user on the same article keep only the earliest timestamp, so
+    the clicks mapping is independent of the order event files are passed
+    (titles keep the first-seen text per nid, which does depend on order
+    when a dump revises a title).
     """
     titles: dict[str, str] = {}
-    clicks: dict[str, list[tuple[int, str]]] = {}
-    seen: set[tuple[str, str]] = set()
+    seen: dict[tuple[str, str], int] = {}  # (uid, nid) -> earliest click time
     for path in paths:
         with open(path, encoding="utf-8") as f:
             for line in f:
@@ -76,10 +78,15 @@ def parse_adressa_events(
                 if not nid or not title or not uid or not isinstance(t, (int, float)):
                     continue
                 titles.setdefault(nid, title)
-                if (uid, nid) in seen:
-                    continue
-                seen.add((uid, nid))
-                clicks.setdefault(uid, []).append((int(t), nid))
+                # dedupe repeat clicks by keeping the EARLIEST timestamp so
+                # chronological histories don't depend on file-read order
+                key = (uid, nid)
+                prev = seen.get(key)
+                if prev is None or int(t) < prev:
+                    seen[key] = int(t)
+    clicks: dict[str, list[tuple[int, str]]] = {}
+    for (uid, nid), t in seen.items():
+        clicks.setdefault(uid, []).append((t, nid))
     for uid in clicks:
         clicks[uid].sort()
     return titles, clicks

@@ -55,6 +55,7 @@ class AdditiveAttention(nn.Module):
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False
+    seq_axis: str | None = None  # sequence-parallel mesh axis (inside shard_map)
 
     @nn.compact
     def __call__(
@@ -62,6 +63,14 @@ class AdditiveAttention(nn.Module):
     ) -> jnp.ndarray:
         fc1 = nn.Dense(self.hidden, dtype=self.dtype, name="att_fc1")
         fc2 = nn.Dense(1, dtype=self.dtype, name="att_fc2")
+        if self.seq_axis is not None:
+            # x holds only this chip's sequence shard; normalize globally
+            from fedrec_tpu.parallel.ring import seq_parallel_pool
+
+            logits = fc2(jnp.tanh(fc1(x)))[..., 0]
+            if mask is not None:
+                mask = mask.astype(logits.dtype)
+            return seq_parallel_pool(x, logits, mask, self.seq_axis)
         if self.use_pallas and self.stable_softmax:
             from fedrec_tpu.ops import additive_pool
 
@@ -94,6 +103,8 @@ class MultiHeadAttention(nn.Module):
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False
+    seq_axis: str | None = None  # sequence-parallel mesh axis (inside shard_map)
+    seq_impl: str = "ring"  # "ring" | "ulysses"
 
     @nn.compact
     def __call__(
@@ -118,6 +129,18 @@ class MultiHeadAttention(nn.Module):
         q_s = split_heads(dense("w_q")(q))  # (..., L, H, Dk)
         k_s = split_heads(dense("w_k")(k))
         v_s = split_heads(dense("w_v")(v))
+
+        if self.seq_axis is not None:
+            # sequence-sharded long-context path; L here is this chip's shard
+            from fedrec_tpu.parallel.ring import ring_attention, ulysses_attention
+
+            if self.seq_impl not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"seq_impl must be 'ring' or 'ulysses', got {self.seq_impl!r}"
+                )
+            sp = ring_attention if self.seq_impl == "ring" else ulysses_attention
+            context = sp(q_s, k_s, v_s, mask, self.seq_axis)
+            return context.reshape(*batch, L, d)
 
         if self.use_pallas and self.stable_softmax:
             # blocked online-softmax kernel: no (..., H, L, L) score tensor

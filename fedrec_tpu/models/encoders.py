@@ -59,6 +59,8 @@ class UserEncoder(nn.Module):
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False
+    seq_axis: str | None = None  # shard history over this mesh axis (long context)
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(
@@ -74,6 +76,8 @@ class UserEncoder(nn.Module):
             stable_softmax=self.stable_softmax,
             dtype=self.dtype,
             use_pallas=self.use_pallas,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
             name="self_attn",
         )(x, x, x, mask)
         return AdditiveAttention(
@@ -81,5 +85,6 @@ class UserEncoder(nn.Module):
             stable_softmax=self.stable_softmax,
             dtype=self.dtype,
             use_pallas=self.use_pallas,
+            seq_axis=self.seq_axis,
             name="pool",
         )(x, mask)

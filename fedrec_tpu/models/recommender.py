@@ -53,6 +53,11 @@ class NewsRecommender(nn.Module):
     """
 
     cfg: ModelConfig
+    # sequence-parallel mesh axis for the user tower (set inside shard_map
+    # regions only — see fedrec_tpu.parallel.ring); None = dense single-chip.
+    # Param trees are identical either way, so clones interoperate freely.
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
 
     def setup(self):
         dtype = jnp.dtype(self.cfg.dtype)
@@ -72,6 +77,8 @@ class NewsRecommender(nn.Module):
             stable_softmax=self.cfg.stable_softmax,
             dtype=dtype,
             use_pallas=self.cfg.use_pallas,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
         )
 
     def encode_news(

@@ -1,13 +1,25 @@
 from fedrec_tpu.parallel.mesh import (
     client_mesh,
     client_sharding,
+    fed_mesh,
     replicated_sharding,
     shard_batch,
+    shard_fed_batch,
+)
+from fedrec_tpu.parallel.ring import (
+    ring_attention,
+    seq_parallel_pool,
+    ulysses_attention,
 )
 
 __all__ = [
     "client_mesh",
     "client_sharding",
+    "fed_mesh",
     "replicated_sharding",
     "shard_batch",
+    "shard_fed_batch",
+    "ring_attention",
+    "seq_parallel_pool",
+    "ulysses_attention",
 ]

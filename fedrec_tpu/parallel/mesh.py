@@ -54,6 +54,47 @@ def client_mesh(num_clients: int, axis: str = CLIENT_AXIS, local: bool = True) -
     return Mesh(mesh_devices, (axis,))
 
 
+def fed_mesh(cfg: Any, local: bool = True) -> Mesh:
+    """Mesh for an ExperimentConfig: 1-D ``(clients,)``, or 2-D
+    ``(clients, seq)`` when ``fed.seq_shards > 1`` (long-history sequence
+    parallelism — each client's history attention spans ``seq_shards`` chips
+    via ring/Ulysses collectives, see ``fedrec_tpu.parallel.ring``).
+    """
+    n_cli, n_seq = cfg.fed.num_clients, cfg.fed.seq_shards
+    if n_seq <= 1:
+        return client_mesh(n_cli, cfg.fed.mesh_axis, local=local)
+    if cfg.data.max_his_len % n_seq != 0:
+        raise ValueError(
+            f"data.max_his_len={cfg.data.max_his_len} must be divisible by "
+            f"fed.seq_shards={n_seq} to shard the history axis"
+        )
+    devices = jax.local_devices() if local else jax.devices()
+    need = n_cli * n_seq
+    if need > len(devices):
+        raise ValueError(
+            f"num_clients*seq_shards={need} exceeds {len(devices)} devices; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count for simulation"
+        )
+    mesh_devices = mesh_utils.create_device_mesh(
+        (n_cli, n_seq), devices=devices[:need]
+    )
+    return Mesh(mesh_devices, (cfg.fed.mesh_axis, cfg.fed.seq_axis))
+
+
+def shard_fed_batch(mesh: Mesh, batch: dict, cfg: Any) -> dict:
+    """Shard a train batch for ``fed_mesh``: every array's dim 0 over the
+    clients axis; additionally ``history``'s last dim over the seq axis when
+    sequence parallelism is on (each chip holds its history slice)."""
+    axis = cfg.fed.mesh_axis
+    if cfg.fed.seq_shards <= 1 or cfg.fed.seq_axis not in mesh.axis_names:
+        return shard_batch(mesh, batch, axis)
+    out = {}
+    for k, v in batch.items():
+        spec = P(axis, None, cfg.fed.seq_axis) if k == "history" else P(axis)
+        out[k] = jax.device_put(np.asarray(v), NamedSharding(mesh, spec))
+    return out
+
+
 def client_sharding(mesh: Mesh, axis: str = CLIENT_AXIS) -> NamedSharding:
     """Leading-axis sharding: array dim 0 is the per-client dim."""
     return NamedSharding(mesh, P(axis))

@@ -1,0 +1,193 @@
+"""Sequence / context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context machinery at all — its attention builds one
+dense ``(bz, heads, 50, 50)`` score tensor (reference ``attention.py:38-44``)
+and caps history at 50 items (reference ``dataset.py:9``). This module makes
+long click-histories a first-class capability of the TPU framework: shard the
+sequence axis over a ``seq`` mesh axis and attend with XLA collectives over
+ICI, so neither the score matrix nor the full K/V sequence ever materializes
+on one chip.
+
+Two interchangeable strategies, both called inside ``shard_map`` with the
+sequence dimension sharded over ``axis_name``:
+
+* ``ring_attention`` — blockwise online-softmax (flash) accumulation while
+  K/V blocks rotate around the ring via ``lax.ppermute``. Per-step compute
+  overlaps with the neighbor exchange; memory is O(L/n) per chip.
+* ``ulysses_attention`` — ``lax.all_to_all`` reshards from sequence-sharded
+  to head-sharded, runs local dense attention over the full sequence for a
+  head subset, and reshards back. One collective pair per call; requires
+  ``num_heads % axis_size == 0``.
+
+Numerics: true max-stabilized softmax with multiplicative key-mask semantics
+matching ``models.attention._masked_normalize`` (stable path) including its
+``+1e-8`` denominator epsilon, so a sequence-parallel run is bit-comparable
+to the single-chip stable path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # finite "-inf": keeps fully-masked blocks NaN-free
+
+
+def _zeros_with_vma_of(ref: jnp.ndarray, shape: tuple, fill: float = 0.0) -> jnp.ndarray:
+    """A constant-filled array typed with ``ref``'s varying-manual-axes.
+
+    shard_map (JAX >= 0.8) tracks which mesh axes a value varies over in its
+    aval; a loop carry initialized from a plain constant is "unvarying" while
+    the body's output varies over every axis the operands do (e.g. both
+    ``clients`` and ``seq`` in a dp x sp layout), which fails scan's
+    carry-type check. Multiplying by a zero slice of ``ref`` broadcasts the
+    constant AND unions in ``ref``'s vma — version-portable, and XLA folds
+    the arithmetic away.
+    """
+    zero = (ref * 0).sum(tuple(range(ref.ndim)))  # scalar 0 carrying ref's vma
+    return jnp.full(shape, fill, dtype=ref.dtype) + zero.astype(ref.dtype)
+
+
+def _scale(dk: int, dtype) -> jnp.ndarray:
+    return jnp.asarray(1.0 / (dk**0.5), dtype=dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Ring flash attention over a sequence-sharded mesh axis.
+
+    Args:
+      q: ``(..., Lq_shard, H, Dk)`` local query block.
+      k, v: ``(..., Lk_shard, H, Dk/Dv)`` local key/value blocks.
+      mask: optional ``(..., Lk_shard)`` key mask (1 = attend) for the local
+        block; rotates around the ring together with K/V.
+      axis_name: mesh axis the sequence is sharded over.
+
+    Returns ``(..., Lq_shard, H, Dv)`` — exactly dense attention over the
+    full (gathered) sequence, computed without ever gathering it.
+    """
+    n = lax.psum(1, axis_name)
+    *batch, lq, h, dk = q.shape
+    dv = v.shape[-1]
+    scale = _scale(dk, q.dtype)
+
+    if mask is None:
+        mask = _zeros_with_vma_of(k, (*batch, k.shape[-3]), fill=1.0)
+    mask = mask.astype(q.dtype)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # anchor: scalar zero carrying the UNION of q/k/v/mask vmas — what the
+    # body outputs
+    anchor = (q * 0).sum() + (k * 0).sum() + (v * 0).sum() + (mask * 0).sum()
+    m0 = _zeros_with_vma_of(anchor, (*batch, h, lq), fill=_NEG)
+    l0 = _zeros_with_vma_of(anchor, (*batch, h, lq))
+    o0 = _zeros_with_vma_of(anchor, (*batch, lq, h, dv))
+
+    def body(i, carry):
+        k_b, v_b, mask_b, m, l, o = carry
+        s = jnp.einsum("...qhd,...khd->...hqk", q, k_b) * scale
+        s = jnp.where(mask_b[..., None, None, :] > 0, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None]) * mask_b[..., None, None, :]
+        corr = jnp.exp(m - m_new)  # (..., H, Lq)
+        l = l * corr + jnp.sum(p, axis=-1)
+        # corr broadcast to o's (..., Lq, H, Dv) layout
+        corr_o = jnp.moveaxis(corr, -2, -1)[..., None]  # (..., Lq, H, 1)
+        o = o * corr_o + jnp.einsum("...hqk,...khd->...qhd", p, v_b)
+
+        def rotate(blocks):
+            return tuple(lax.ppermute(b, axis_name, perm) for b in blocks)
+
+        # the last iteration's rotation would be discarded — skip the ICI hop
+        k_b, v_b, mask_b = lax.cond(
+            i < n - 1, rotate, lambda b: b, (k_b, v_b, mask_b)
+        )
+        return k_b, v_b, mask_b, m_new, l, o
+
+    _, _, _, _, l, o = lax.fori_loop(0, n, body, (k, v, mask, m0, l0, o0))
+    denom = jnp.moveaxis(l, -2, -1)[..., None] + 1e-8  # (..., Lq, H, 1)
+    return o / denom
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Same shard layout and semantics as ``ring_attention``; requires the head
+    count to divide evenly by the axis size. Reshards seq->heads, attends
+    densely over the full sequence locally, reshards back.
+    """
+    n = lax.psum(1, axis_name)
+    *batch, lq, h, dk = q.shape
+    if h % n != 0:
+        raise ValueError(f"num_heads={h} not divisible by axis size {n}")
+    nb = len(batch)
+
+    def to_heads(x):
+        # (..., L_shard, H, D) -> (..., L, H/n, D)
+        return lax.all_to_all(
+            x, axis_name, split_axis=nb + 1, concat_axis=nb, tiled=True
+        )
+
+    q_g, k_g, v_g = to_heads(q), to_heads(k), to_heads(v)
+    if mask is not None:
+        mask_g = lax.all_gather(
+            mask.astype(q.dtype), axis_name, axis=nb, tiled=True
+        )
+        bias = jnp.where(mask_g[..., None, None, :] > 0, 0.0, _NEG).astype(q.dtype)
+    else:
+        mask_g = None
+        bias = None
+
+    s = jnp.einsum("...qhd,...khd->...hqk", q_g, k_g) * _scale(dk, q.dtype)
+    if bias is not None:
+        s = s + bias
+    s = s - jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s)
+    if mask_g is not None:
+        p = p * mask_g[..., None, None, :]
+    p = p / (jnp.sum(p, axis=-1, keepdims=True) + 1e-8)
+    o = jnp.einsum("...hqk,...khd->...qhd", p, v_g)
+    # (..., L, H/n, D) -> (..., L_shard, H, D)
+    return lax.all_to_all(o, axis_name, split_axis=nb, concat_axis=nb + 1, tiled=True)
+
+
+def seq_parallel_pool(
+    x: jnp.ndarray,
+    logits: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Additive-attention pooling across a sequence-sharded axis.
+
+    ``x``: ``(..., L_shard, D)`` values; ``logits``: ``(..., L_shard)``
+    unnormalized attention scores (the local ``fc2(tanh(fc1 x))`` output);
+    ``mask``: optional ``(..., L_shard)``. Normalization (max + denominator)
+    runs over the GLOBAL sequence via ``lax.pmax``/``lax.psum``; returns the
+    pooled ``(..., D)`` vector, identical on every ``seq`` shard.
+    """
+    if mask is not None:
+        logits = jnp.where(mask > 0, logits, _NEG)
+    # max-shift is softmax-invariant -> no gradient flows through it (pmax has
+    # no AD rule anyway)
+    g_max = lax.pmax(
+        jnp.max(jax.lax.stop_gradient(logits), axis=-1), axis_name
+    )  # (...)
+    w = jnp.exp(logits - g_max[..., None])
+    if mask is not None:
+        w = w * mask.astype(w.dtype)
+    denom = lax.psum(jnp.sum(w, axis=-1), axis_name) + 1e-8
+    local = jnp.einsum("...l,...ld->...d", w, x)
+    return lax.psum(local, axis_name) / denom[..., None]

@@ -50,6 +50,29 @@ from fedrec_tpu.train.state import ClientState, make_optimizers
 
 
 # ----------------------------------------------------------------- helpers
+@jax.custom_vjp
+def _scale_grad(x: jnp.ndarray, s: float) -> jnp.ndarray:
+    """Identity forward; scales the cotangent by ``s`` on the way back.
+
+    Used under sequence parallelism: replicated computations (candidate
+    encoding runs identically on every seq shard) would have their gradient
+    counted ``n_seq`` times by the post-grad ``psum`` — scaling by ``1/n_seq``
+    makes the psum sum to exactly one contribution.
+    """
+    return x
+
+
+def _scale_grad_fwd(x, s):
+    return x, s
+
+
+def _scale_grad_bwd(s, g):
+    return (g * s, None)
+
+
+_scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)
+
+
 def _unstack(tree: Any) -> Any:
     """Strip the local leading block dim (size 1) inside shard_map."""
     return jax.tree_util.tree_map(lambda x: x[0], tree)
@@ -146,9 +169,30 @@ def build_fed_train_step(
     mode = mode or ("joint" if cfg.model.text_encoder_mode != "table" else "decoupled")
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
     axis = cfg.fed.mesh_axis
+    # sequence parallelism: history sharded over a second mesh axis, user
+    # tower attends via ring/Ulysses collectives (fedrec_tpu.parallel.ring)
+    n_seq = cfg.fed.seq_shards
+    seq_ax = cfg.fed.seq_axis
+    if n_seq > 1:
+        if mode != "joint":
+            raise NotImplementedError(
+                "fed.seq_shards > 1 requires mode='joint' (the decoupled "
+                "news-grad accumulator is not seq-sharded)"
+            )
+        if seq_ax not in mesh.axis_names:
+            raise ValueError(
+                f"fed.seq_shards={n_seq} but mesh {mesh.axis_names} has no "
+                f"{seq_ax!r} axis — build the mesh with parallel.mesh.fed_mesh"
+            )
+        model = model.clone(seq_axis=seq_ax, seq_impl=cfg.fed.seq_impl)
     if noise_fn is None and cfg.privacy.enabled:
         noise_fn = make_noise_fn(cfg.privacy, cfg.data.batch_size)
     use_dpsgd = cfg.privacy.enabled and cfg.privacy.mechanism == "dpsgd"
+    if use_dpsgd and n_seq > 1:
+        raise NotImplementedError(
+            "per-example DP-SGD with sequence parallelism is not supported; "
+            "use seq_shards=1 with mechanism='dpsgd'"
+        )
     if use_dpsgd and mode != "joint":
         # decoupled mode has no per-example clipping path yet; noising
         # unclipped grads with a DP-SGD-calibrated sigma would claim an
@@ -160,6 +204,10 @@ def build_fed_train_step(
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
+        if n_seq > 1:
+            # distinct dropout masks per history shard (state.rng is
+            # replicated over the seq axis)
+            dropout_rng = jax.random.fold_in(dropout_rng, lax.axis_index(seq_ax))
 
         if mode == "joint":
             if use_dpsgd:
@@ -202,6 +250,10 @@ def build_fed_train_step(
                     cand_vecs, his_vecs = _batch_news_vecs(
                         model, news_params, table, batch["candidates"], batch["history"]
                     )
+                    if n_seq > 1:
+                        # candidate encoding is replicated across seq shards;
+                        # scale so the post-grad psum counts it exactly once
+                        cand_vecs = _scale_grad(cand_vecs, 1.0 / n_seq)
                     scores = model.apply(
                         {"params": {"user_encoder": user_params}},
                         cand_vecs,
@@ -216,6 +268,15 @@ def build_fed_train_step(
                 loss, (user_g, news_g) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                     state.user_params, state.news_params
                 )
+                if n_seq > 1:
+                    # each seq shard holds a partial param grad (its history
+                    # slice); sum -> full grad, replicated over seq
+                    user_g = jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, seq_ax), user_g
+                    )
+                    news_g = jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, seq_ax), news_g
+                    )
             if noise_fn is not None:
                 user_g, news_g = noise_fn((user_g, news_g), noise_rng)
             user_g = strategy.sync_grads(user_g, axis)
@@ -285,10 +346,21 @@ def build_fed_train_step(
         mean_loss = lax.pmean(loss, axis_name=axis)
         return new_state, {"loss": loss, "mean_loss": mean_loss}
 
+    if n_seq > 1:
+        # history's last dim lives sharded over the seq axis; the step then
+        # requires exactly the canonical batch keys (shard_fed_batch's layout)
+        batch_spec: Any = {
+            "candidates": P(axis),
+            "history": P(axis, None, seq_ax),
+            "labels": P(axis),
+        }
+    else:
+        batch_spec = P(axis)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P()),
+        in_specs=(P(axis), batch_spec, P()),
         out_specs=(P(axis), P(axis)),
         check_vma=False,
     )

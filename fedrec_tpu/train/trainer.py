@@ -34,7 +34,11 @@ from fedrec_tpu.data.batcher import IndexedSamples, TrainBatcher, index_samples
 from fedrec_tpu.data.mind import MindData
 from fedrec_tpu.fed.strategies import get_strategy
 from fedrec_tpu.models import NewsRecommender
-from fedrec_tpu.parallel.mesh import client_mesh, client_sharding, shard_batch
+from fedrec_tpu.parallel.mesh import (
+    client_sharding,
+    fed_mesh,
+    shard_fed_batch,
+)
 from fedrec_tpu.train.checkpoint import SnapshotManager
 from fedrec_tpu.train.state import init_client_state, replicate_state
 from fedrec_tpu.train.step import (
@@ -74,7 +78,7 @@ class Trainer:
         self.data = data
         self.model = NewsRecommender(cfg.model)
         self.strategy = get_strategy(cfg.fed.strategy)
-        self.mesh = client_mesh(cfg.fed.num_clients, cfg.fed.mesh_axis)
+        self.mesh = fed_mesh(cfg)
         self.mode = "joint" if cfg.model.text_encoder_mode != "table" else "decoupled"
 
         self.token_states = jnp.asarray(token_states, dtype=jnp.dtype(cfg.model.dtype))
@@ -186,14 +190,14 @@ class Trainer:
             for batch in self.batcher.epoch_batches_sharded(
                 cfg.fed.num_clients, epoch_idx
             ):
-                sharded = shard_batch(
+                sharded = shard_fed_batch(
                     self.mesh,
                     {
                         "candidates": batch.candidates,
                         "history": batch.history,
                         "labels": batch.labels,
                     },
-                    cfg.fed.mesh_axis,
+                    cfg,
                 )
                 self.state, metrics = self.train_step(self.state, sharded, table)
                 losses.append(metrics["mean_loss"])
