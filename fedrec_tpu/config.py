@@ -29,6 +29,10 @@ class DataConfig:
     shuffle: bool = True
     seed: int = 0
     drop_remainder: bool = True        # static shapes under jit
+    # host-side batch assembly in the native C++ engine (threaded; see
+    # native/fedrec_data.cpp). Falls back to the Python batcher if the
+    # library is unavailable.
+    native_loader: bool = False
 
 
 @dataclass

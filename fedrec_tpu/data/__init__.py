@@ -8,6 +8,10 @@ from fedrec_tpu.data.batcher import (
     shard_indices,
 )
 from fedrec_tpu.data.adressa import parse_adressa_events, preprocess_adressa
+from fedrec_tpu.data.native_batcher import (
+    NativeTrainBatcher,
+    is_available as native_batcher_available,
+)
 from fedrec_tpu.data.preprocess import (
     build_news_index,
     parse_behaviors_tsv,
@@ -26,7 +30,9 @@ __all__ = [
     "HashingTokenizer",
     "IndexedSamples",
     "MindData",
+    "NativeTrainBatcher",
     "TrainBatcher",
+    "native_batcher_available",
     "WordPieceTokenizer",
     "build_news_index",
     "get_tokenizer",

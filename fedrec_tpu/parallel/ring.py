@@ -77,15 +77,17 @@ def ring_attention(
     dv = v.shape[-1]
     scale = _scale(dk, q.dtype)
 
-    if mask is None:
-        mask = _zeros_with_vma_of(k, (*batch, k.shape[-3]), fill=1.0)
-    mask = mask.astype(q.dtype)
+    has_mask = mask is not None
+    if has_mask:
+        mask = mask.astype(q.dtype)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     # anchor: scalar zero carrying the UNION of q/k/v/mask vmas — what the
     # body outputs
-    anchor = (q * 0).sum() + (k * 0).sum() + (v * 0).sum() + (mask * 0).sum()
+    anchor = (q * 0).sum() + (k * 0).sum() + (v * 0).sum()
+    if has_mask:
+        anchor = anchor + (mask * 0).sum()
     m0 = _zeros_with_vma_of(anchor, (*batch, h, lq), fill=_NEG)
     l0 = _zeros_with_vma_of(anchor, (*batch, h, lq))
     o0 = _zeros_with_vma_of(anchor, (*batch, lq, h, dv))
@@ -93,9 +95,12 @@ def ring_attention(
     def body(i, carry):
         k_b, v_b, mask_b, m, l, o = carry
         s = jnp.einsum("...qhd,...khd->...hqk", q, k_b) * scale
-        s = jnp.where(mask_b[..., None, None, :] > 0, s, _NEG)
+        if has_mask:
+            s = jnp.where(mask_b[..., None, None, :] > 0, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None]) * mask_b[..., None, None, :]
+        p = jnp.exp(s - m_new[..., None])
+        if has_mask:
+            p = p * mask_b[..., None, None, :]
         corr = jnp.exp(m - m_new)  # (..., H, Lq)
         l = l * corr + jnp.sum(p, axis=-1)
         # corr broadcast to o's (..., Lq, H, Dv) layout
@@ -103,7 +108,12 @@ def ring_attention(
         o = o * corr_o + jnp.einsum("...hqk,...khd->...qhd", p, v_b)
 
         def rotate(blocks):
-            return tuple(lax.ppermute(b, axis_name, perm) for b in blocks)
+            kb, vb, mb = blocks
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+            if has_mask:  # maskless path skips the mask hop entirely
+                mb = lax.ppermute(mb, axis_name, perm)
+            return kb, vb, mb
 
         # the last iteration's rotation would be discarded — skip the ICI hop
         k_b, v_b, mask_b = lax.cond(
@@ -111,7 +121,11 @@ def ring_attention(
         )
         return k_b, v_b, mask_b, m_new, l, o
 
-    _, _, _, _, l, o = lax.fori_loop(0, n, body, (k, v, mask, m0, l0, o0))
+    # maskless path rotates only K/V; a dummy scalar keeps the carry shape
+    mask_carry = mask if has_mask else anchor
+    _, _, _, _, l, o = lax.fori_loop(
+        0, n, body, (k, v, mask_carry, m0, l0, o0)
+    )
     denom = jnp.moveaxis(l, -2, -1)[..., None] + 1e-8  # (..., Lq, H, 1)
     return o / denom
 

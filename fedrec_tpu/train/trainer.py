@@ -84,7 +84,15 @@ class Trainer:
         self.token_states = jnp.asarray(token_states, dtype=jnp.dtype(cfg.model.dtype))
 
         train_ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
-        self.batcher = TrainBatcher(
+        batcher_cls = TrainBatcher
+        if cfg.data.native_loader:
+            from fedrec_tpu.data import native_batcher
+
+            if native_batcher.is_available():
+                batcher_cls = native_batcher.NativeTrainBatcher
+            else:
+                print("[trainer] native loader unavailable; using Python batcher")
+        self.batcher = batcher_cls(
             train_ix,
             cfg.data.batch_size,
             cfg.data.npratio,

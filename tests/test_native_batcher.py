@@ -1,0 +1,148 @@
+"""Native C++ data engine vs the Python batcher (structural equivalence).
+
+The engine's RNG is its own deterministic stream, so negative draws are not
+bit-identical to numpy's — equivalence is asserted on everything RNG-free
+(order, sharding, padding, positives, histories) and on distributional /
+structural properties of the sampled negatives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.data.batcher import IndexedSamples, TrainBatcher
+from fedrec_tpu.data import native_batcher
+from fedrec_tpu.data.native_batcher import NativeTrainBatcher
+
+
+pytestmark = pytest.mark.skipif(
+    not native_batcher.is_available(), reason="native engine not built"
+)
+
+
+def make_indexed(n=37, max_pool=12, max_his=10, seed=0, short_pools=False):
+    rng = np.random.default_rng(seed)
+    pos = rng.integers(1, 200, n).astype(np.int32)
+    neg_lens = (
+        rng.integers(1, 4, n) if short_pools else rng.integers(6, max_pool + 1, n)
+    ).astype(np.int32)
+    neg_pools = np.zeros((n, max_pool), np.int32)
+    for i in range(n):
+        neg_pools[i, : neg_lens[i]] = rng.integers(1, 200, neg_lens[i])
+    his_len = rng.integers(0, max_his + 1, n).astype(np.int32)
+    history = np.zeros((n, max_his), np.int32)
+    for i in range(n):
+        history[i, : his_len[i]] = rng.integers(1, 200, his_len[i])
+    return IndexedSamples(pos, neg_pools, neg_lens, history, his_len)
+
+
+def batchers(ix, **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("npratio", 4)
+    kw.setdefault("seed", 3)
+    nthreads = kw.pop("num_threads", 0)
+    return TrainBatcher(ix, **kw), NativeTrainBatcher(ix, num_threads=nthreads, **kw)
+
+
+def test_unsharded_matches_python_on_rng_free_fields():
+    ix = make_indexed()
+    py, nat = batchers(ix, shuffle=False, drop_remainder=False)
+    py_batches = list(py.epoch_batches(0))
+    nat_batches = list(nat.epoch_batches(0))
+    assert len(py_batches) == len(nat_batches) == py.num_batches()
+    for pb, nb in zip(py_batches, nat_batches):
+        np.testing.assert_array_equal(nb.candidates[:, 0], pb.candidates[:, 0])
+        np.testing.assert_array_equal(nb.history, pb.history)
+        np.testing.assert_array_equal(nb.his_len, pb.his_len)
+        np.testing.assert_array_equal(nb.labels, pb.labels)
+        assert nb.candidates.shape == pb.candidates.shape
+
+
+def test_sharded_matches_python_on_rng_free_fields():
+    ix = make_indexed(n=53)
+    py, nat = batchers(ix, shuffle=False)
+    n_cli = 4
+    py_batches = list(py.epoch_batches_sharded(n_cli, 0))
+    nat_batches = list(nat.epoch_batches_sharded(n_cli, 0))
+    assert len(py_batches) == len(nat_batches) > 0
+    for pb, nb in zip(py_batches, nat_batches):
+        assert nb.candidates.shape == pb.candidates.shape == (n_cli, 8, 5)
+        np.testing.assert_array_equal(nb.candidates[..., 0], pb.candidates[..., 0])
+        np.testing.assert_array_equal(nb.history, pb.history)
+        np.testing.assert_array_equal(nb.his_len, pb.his_len)
+
+
+def test_negatives_come_from_the_pool_and_are_distinct():
+    ix = make_indexed(n=29)
+    _, nat = batchers(ix, shuffle=False, drop_remainder=False)
+    for b in nat.epoch_batches(0):
+        for j in range(b.candidates.shape[0]):
+            # recover the sample: positive identifies it only with shuffle off
+            negs = b.candidates[j, 1:]
+            assert len(set(negs.tolist())) == len(negs)  # without replacement
+
+
+def test_short_pools_keep_all_and_pad_zero():
+    ix = make_indexed(n=16, short_pools=True)
+    _, nat = batchers(ix, shuffle=False, drop_remainder=False, batch_size=16)
+    (batch,) = list(nat.epoch_batches(0))
+    for j in range(16):
+        pool = set(ix.neg_pools[j, : ix.neg_lens[j]].tolist())
+        negs = batch.candidates[j, 1:]
+        k = int(ix.neg_lens[j])
+        assert set(negs[:k].tolist()) == pool  # whole pool kept, order aside
+        assert (negs[k:] == 0).all()  # <unk> padding (dataset.py:11-12)
+
+
+def test_determinism_and_seed_sensitivity():
+    ix = make_indexed()
+    _, a = batchers(ix, seed=7)
+    _, b = batchers(ix, seed=7)
+    _, c = batchers(ix, seed=8)
+    ba = list(a.epoch_batches_sharded(2, epoch=1))
+    bb = list(b.epoch_batches_sharded(2, epoch=1))
+    bc = list(c.epoch_batches_sharded(2, epoch=1))
+    for x, y in zip(ba, bb):
+        np.testing.assert_array_equal(x.candidates, y.candidates)
+        np.testing.assert_array_equal(x.history, y.history)
+    assert any(
+        not np.array_equal(x.candidates, z.candidates) for x, z in zip(ba, bc)
+    )
+
+
+def test_shuffle_is_a_permutation():
+    ix = make_indexed(n=32)
+    _, nat = batchers(ix, shuffle=True, drop_remainder=False, batch_size=8)
+    seen = np.concatenate(
+        [b.candidates[:, 0] for b in nat.epoch_batches(0)]
+    )
+    assert sorted(seen.tolist()) == sorted(ix.pos.tolist())
+    # different epochs shuffle differently
+    seen2 = np.concatenate(
+        [b.candidates[:, 0] for b in nat.epoch_batches(1)]
+    )
+    assert not np.array_equal(seen, seen2)
+
+
+def test_epoch_arrays_sharded_matches_batch_iteration():
+    """The threaded whole-epoch fill == per-batch fills, exactly."""
+    ix = make_indexed(n=61)
+    _, nat = batchers(ix, num_threads=4)
+    arrs = nat.epoch_arrays_sharded(3, epoch=2)
+    batches = list(nat.epoch_batches_sharded(3, epoch=2))
+    assert arrs.candidates.shape[0] == len(batches)
+    for s, b in enumerate(batches):
+        np.testing.assert_array_equal(arrs.candidates[s], b.candidates)
+        np.testing.assert_array_equal(arrs.history[s], b.history)
+        np.testing.assert_array_equal(arrs.his_len[s], b.his_len)
+        np.testing.assert_array_equal(arrs.labels[s], b.labels)
+
+
+def test_wrap_around_padding_when_batch_exceeds_shard():
+    ix = make_indexed(n=5)
+    py, nat = batchers(ix, shuffle=False, drop_remainder=False, batch_size=8)
+    (pb,) = list(py.epoch_batches(0))
+    (nb,) = list(nat.epoch_batches(0))
+    np.testing.assert_array_equal(nb.candidates[:, 0], pb.candidates[:, 0])
+    np.testing.assert_array_equal(nb.history, pb.history)

@@ -72,6 +72,26 @@ def test_sp_attention_matches_dense(impl):
 
 
 @pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+def test_sp_attention_maskless_matches_dense(impl):
+    q, k, v, _ = _rand_qkv(seed=5)
+    ones = jnp.ones(q.shape[:2], jnp.float32)
+    want = _dense_reference(q, k, v, ones)
+
+    fn = shard_map(
+        lambda a, b, c: impl(a, b, c, None, axis_name="seq"),
+        mesh=_mesh(),
+        in_specs=(
+            P(None, "seq", None, None),
+            P(None, "seq", None, None),
+            P(None, "seq", None, None),
+        ),
+        out_specs=P(None, "seq", None, None),
+    )
+    got = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
 def test_sp_attention_grads_match_dense(impl):
     q, k, v, mask = _rand_qkv(seed=1)
 

@@ -66,6 +66,24 @@ def test_trainer_runs_rounds(tmp_path, strategy, mode):
     assert history[-1].val_metrics and 0 <= history[-1].val_metrics["auc"] <= 1
 
 
+def test_trainer_native_loader_round(tmp_path):
+    """Full round with host batches assembled by the C++ engine."""
+    from fedrec_tpu.data import native_batcher
+    from fedrec_tpu.train.trainer import Trainer
+
+    if not native_batcher.is_available():
+        pytest.skip("native engine not built")
+    cfg = tiny_cfg(tmp_path, data__native_loader=True, fed__rounds=1)
+    cfg.model.text_encoder_mode = "head"
+    data, token_states = tiny_data(cfg)
+    trainer = Trainer(cfg, data, token_states)
+    from fedrec_tpu.data.native_batcher import NativeTrainBatcher
+
+    assert isinstance(trainer.batcher, NativeTrainBatcher)
+    history = trainer.run()
+    assert len(history) == 1 and np.isfinite(history[0].train_loss)
+
+
 def test_trainer_resume_bit_identical(tmp_path):
     """Interrupted-and-resumed == uninterrupted (full state snapshot)."""
     from fedrec_tpu.train.trainer import Trainer
