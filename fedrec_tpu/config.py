@@ -49,6 +49,13 @@ class ModelConfig:
     # "head"     — frozen-trunk token states + trainable additive-attn/linear head
     # "finetune" — full DistilBERT fine-tuned in-loop (BASELINE config 5)
     text_encoder_mode: str = "table"
+    # trunk architecture for "finetune" mode (defaults = distilbert-base;
+    # shrink for tests). dim is bert_hidden above.
+    trunk_layers: int = 6
+    trunk_heads: int = 12
+    trunk_ffn: int = 3072
+    trunk_vocab: int = 30522
+    trunk_remat: bool = True           # jax.checkpoint per block (HBM for FLOPs)
     # numerics: the reference uses unstabilized exp-normalization
     # (``attention.py:19,39``) — a defect; we default to stable softmax and keep
     # the knob for bit-parity experiments.

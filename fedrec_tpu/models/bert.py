@@ -252,6 +252,28 @@ def init_trunk_params(
     return model.init(rng, dummy_ids, dummy_mask)["params"]
 
 
+def trunk_config_from(model_cfg) -> DistilBertConfig:
+    """DistilBertConfig from a ``ModelConfig`` (finetune-mode trunk knobs)."""
+    return DistilBertConfig(
+        vocab_size=model_cfg.trunk_vocab,
+        dim=model_cfg.bert_hidden,
+        n_layers=model_cfg.trunk_layers,
+        n_heads=model_cfg.trunk_heads,
+        hidden_dim=model_cfg.trunk_ffn,
+    )
+
+
+def make_text_encoder(model_cfg) -> "TextEncoder":
+    """Full trainable text tower for ``text_encoder_mode='finetune'``."""
+    return TextEncoder(
+        trunk_cfg=trunk_config_from(model_cfg),
+        news_dim=model_cfg.news_dim,
+        stable_softmax=model_cfg.stable_softmax,
+        dtype=jnp.dtype(model_cfg.dtype),
+        remat=model_cfg.trunk_remat,
+    )
+
+
 class TextEncoder(nn.Module):
     """Full text tower: DistilBERT trunk + additive-attention head.
 

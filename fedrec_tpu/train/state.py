@@ -74,7 +74,17 @@ def init_client_state(
         method=NewsRecommender.init_both_towers,
     )
     user_params = variables["params"]["user_encoder"]
-    news_params = variables["params"]["text_head"]
+    if cfg.model.text_encoder_mode == "finetune":
+        # news tower = full TextEncoder (trunk + head), trained in-loop
+        # (BASELINE config 5); pretrained trunk weights can be grafted in
+        # afterwards via models.bert.load_hf_state_dict
+        from fedrec_tpu.models.bert import make_text_encoder
+
+        te = make_text_encoder(cfg.model)
+        dummy_tokens = jnp.zeros((1, 2, title_len), jnp.int32)
+        news_params = te.init(init_rng, dummy_tokens)["params"]
+    else:
+        news_params = variables["params"]["text_head"]
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
     return ClientState(
         step=jnp.zeros((), jnp.int32),

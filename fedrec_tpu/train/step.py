@@ -114,6 +114,57 @@ def _batch_news_vecs(
     return cand_vecs, his_vecs
 
 
+def _batch_news_vecs_tokens(
+    text_encoder: Any,
+    news_params: Any,
+    tokens_table: jnp.ndarray,
+    candidates: jnp.ndarray,
+    history: jnp.ndarray,
+    dropout_rng: jax.Array | None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Finetune-mode analogue of ``_batch_news_vecs``: gather the batch's
+    unique news TOKEN rows from the (N, 2, L) table and run the full
+    trainable TextEncoder (trunk + head) on them."""
+    b, c = candidates.shape
+    h = history.shape[1]
+    ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
+    size = min(ids.shape[0], tokens_table.shape[0])
+    uniq, inv = jnp.unique(ids, size=size, fill_value=0, return_inverse=True)
+    toks = tokens_table[uniq]  # (size, 2, L)
+    train = dropout_rng is not None
+    vecs = text_encoder.apply(
+        {"params": news_params},
+        toks,
+        train,
+        rngs={"dropout": dropout_rng} if train else None,
+    )  # (size, D)
+    flat = vecs[inv]
+    cand_vecs = flat[: b * c].reshape(b, c, -1)
+    his_vecs = flat[b * c :].reshape(b, h, -1)
+    return cand_vecs, his_vecs
+
+
+def encode_corpus_tokens(
+    text_encoder: Any,
+    news_params: Any,
+    news_tokens: jnp.ndarray,
+    chunk: int = 512,
+) -> jnp.ndarray:
+    """(N, 2, L) token table -> (N, D) news vectors via the full TextEncoder
+    (finetune-mode corpus encode for evaluation), chunked over N."""
+    n = news_tokens.shape[0]
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    padded = jnp.pad(news_tokens, ((0, pad), (0, 0), (0, 0)))
+    chunks = padded.reshape(-1, chunk, *padded.shape[1:])
+
+    def encode(c):
+        return text_encoder.apply({"params": news_params}, c)
+
+    vecs = lax.map(encode, chunks)
+    return vecs.reshape(-1, vecs.shape[-1])[:n]
+
+
 def encode_all_news(
     model: NewsRecommender,
     news_params: Any,
@@ -166,7 +217,15 @@ def build_fed_train_step(
     is built from the config; with ``mechanism='dpsgd'`` the joint path
     additionally switches to per-example clipped gradients.
     """
-    mode = mode or ("joint" if cfg.model.text_encoder_mode != "table" else "decoupled")
+    if mode is None:
+        mode = {"table": "decoupled", "head": "joint", "finetune": "finetune"}.get(
+            cfg.model.text_encoder_mode, "joint"
+        )
+    text_encoder = None
+    if mode == "finetune":
+        from fedrec_tpu.models.bert import make_text_encoder
+
+        text_encoder = make_text_encoder(cfg.model)
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
     axis = cfg.fed.mesh_axis
     # sequence parallelism: history sharded over a second mesh axis, user
@@ -174,10 +233,10 @@ def build_fed_train_step(
     n_seq = cfg.fed.seq_shards
     seq_ax = cfg.fed.seq_axis
     if n_seq > 1:
-        if mode != "joint":
+        if mode not in ("joint", "finetune"):
             raise NotImplementedError(
-                "fed.seq_shards > 1 requires mode='joint' (the decoupled "
-                "news-grad accumulator is not seq-sharded)"
+                "fed.seq_shards > 1 requires mode='joint'/'finetune' (the "
+                "decoupled news-grad accumulator is not seq-sharded)"
             )
         if seq_ax not in mesh.axis_names:
             raise ValueError(
@@ -193,6 +252,11 @@ def build_fed_train_step(
             "per-example DP-SGD with sequence parallelism is not supported; "
             "use seq_shards=1 with mechanism='dpsgd'"
         )
+    if use_dpsgd and mode == "finetune":
+        raise NotImplementedError(
+            "per-example DP-SGD over the full trunk is not supported; use "
+            "mode='joint' (frozen trunk) for DP training"
+        )
     if use_dpsgd and mode != "joint":
         # decoupled mode has no per-example clipping path yet; noising
         # unclipped grads with a DP-SGD-calibrated sigma would claim an
@@ -204,12 +268,15 @@ def build_fed_train_step(
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
+        # text-encoder dropout key must be IDENTICAL across seq shards so the
+        # replicated candidate encode stays replicated (finetune mode)
+        enc_rng = jax.random.fold_in(dropout_rng, 1)
         if n_seq > 1:
-            # distinct dropout masks per history shard (state.rng is
-            # replicated over the seq axis)
+            # distinct user-encoder dropout masks per history shard
+            # (state.rng is replicated over the seq axis)
             dropout_rng = jax.random.fold_in(dropout_rng, lax.axis_index(seq_ax))
 
-        if mode == "joint":
+        if mode in ("joint", "finetune"):
             if use_dpsgd:
                 # DP-SGD: per-example grads, clipped to C, averaged; each
                 # example encodes its own C+H news directly (no cross-example
@@ -247,9 +314,18 @@ def build_fed_train_step(
             else:
 
                 def loss_fn(user_params, news_params):
-                    cand_vecs, his_vecs = _batch_news_vecs(
-                        model, news_params, table, batch["candidates"], batch["history"]
-                    )
+                    if mode == "finetune":
+                        # table = raw (N, 2, L) token rows; full trunk + head
+                        # runs (and trains) on the batch's unique news
+                        cand_vecs, his_vecs = _batch_news_vecs_tokens(
+                            text_encoder, news_params, table,
+                            batch["candidates"], batch["history"], enc_rng,
+                        )
+                    else:
+                        cand_vecs, his_vecs = _batch_news_vecs(
+                            model, news_params, table,
+                            batch["candidates"], batch["history"],
+                        )
                     if n_seq > 1:
                         # candidate encoding is replicated across seq shards;
                         # scale so the post-grad psum counts it exactly once

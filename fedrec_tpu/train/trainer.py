@@ -79,9 +79,23 @@ class Trainer:
         self.model = NewsRecommender(cfg.model)
         self.strategy = get_strategy(cfg.fed.strategy)
         self.mesh = fed_mesh(cfg)
-        self.mode = "joint" if cfg.model.text_encoder_mode != "table" else "decoupled"
+        self.mode = {"table": "decoupled", "head": "joint", "finetune": "finetune"}.get(
+            cfg.model.text_encoder_mode, "joint"
+        )
 
-        self.token_states = jnp.asarray(token_states, dtype=jnp.dtype(cfg.model.dtype))
+        self.text_encoder = None
+        self.news_tokens: jnp.ndarray | None = None
+        if self.mode == "finetune":
+            # in-loop trunk training reads raw token rows, not cached states
+            from fedrec_tpu.models.bert import make_text_encoder
+
+            self.text_encoder = make_text_encoder(cfg.model)
+            self.news_tokens = jnp.asarray(data.news_tokens, jnp.int32)
+            self.token_states = None
+        else:
+            self.token_states = jnp.asarray(
+                token_states, dtype=jnp.dtype(cfg.model.dtype)
+            )
 
         train_ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
         batcher_cls = TrainBatcher
@@ -174,7 +188,17 @@ class Trainer:
         self._table = encode_all_news(self.model, news_params, self.token_states)
         return self._table
 
+    def _encode_corpus(self, news_params) -> jnp.ndarray:
+        """(N, D) news-vector table from client params, any text-encoder mode."""
+        if self.mode == "finetune":
+            from fedrec_tpu.train.step import encode_corpus_tokens
+
+            return encode_corpus_tokens(self.text_encoder, news_params, self.news_tokens)
+        return encode_all_news(self.model, news_params, self.token_states)
+
     def _feature_table(self) -> jnp.ndarray:
+        if self.mode == "finetune":
+            return self.news_tokens
         if self.mode == "joint":
             return self.token_states
         if self._table is None:
