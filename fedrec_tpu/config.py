@@ -55,6 +55,7 @@ class ModelConfig:
     trunk_heads: int = 12
     trunk_ffn: int = 3072
     trunk_vocab: int = 30522
+    trunk_dropout: float = 0.1         # trunk hidden+attention dropout (HF default)
     trunk_remat: bool = True           # jax.checkpoint per block (HBM for FLOPs)
     # numerics: the reference uses unstabilized exp-normalization
     # (``attention.py:19,39``) — a defect; we default to stable softmax and keep

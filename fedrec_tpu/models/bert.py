@@ -260,6 +260,8 @@ def trunk_config_from(model_cfg) -> DistilBertConfig:
         n_layers=model_cfg.trunk_layers,
         n_heads=model_cfg.trunk_heads,
         hidden_dim=model_cfg.trunk_ffn,
+        dropout=model_cfg.trunk_dropout,
+        attention_dropout=model_cfg.trunk_dropout,
     )
 
 

@@ -114,20 +114,18 @@ def _batch_news_vecs(
     return cand_vecs, his_vecs
 
 
-def _batch_news_vecs_tokens(
+def _encode_unique_tokens(
     text_encoder: Any,
     news_params: Any,
     tokens_table: jnp.ndarray,
-    candidates: jnp.ndarray,
-    history: jnp.ndarray,
+    ids: jnp.ndarray,
     dropout_rng: jax.Array | None,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Finetune-mode analogue of ``_batch_news_vecs``: gather the batch's
-    unique news TOKEN rows from the (N, 2, L) table and run the full
-    trainable TextEncoder (trunk + head) on them."""
-    b, c = candidates.shape
-    h = history.shape[1]
-    ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
+) -> jnp.ndarray:
+    """Encode a flat id vector's unique news through the full TextEncoder.
+
+    Gathers the unique token rows from the (N, 2, L) table, runs trunk +
+    head once per distinct news, and scatters back to (len(ids), D).
+    """
     size = min(ids.shape[0], tokens_table.shape[0])
     uniq, inv = jnp.unique(ids, size=size, fill_value=0, return_inverse=True)
     toks = tokens_table[uniq]  # (size, 2, L)
@@ -138,10 +136,54 @@ def _batch_news_vecs_tokens(
         train,
         rngs={"dropout": dropout_rng} if train else None,
     )  # (size, D)
-    flat = vecs[inv]
+    return vecs[inv]
+
+
+def _batch_news_vecs_tokens(
+    text_encoder: Any,
+    news_params: Any,
+    tokens_table: jnp.ndarray,
+    candidates: jnp.ndarray,
+    history: jnp.ndarray,
+    dropout_rng: jax.Array | None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Finetune-mode analogue of ``_batch_news_vecs``: one joint dedup over
+    candidate + history ids, full trainable TextEncoder on the unique rows."""
+    b, c = candidates.shape
+    h = history.shape[1]
+    ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
+    flat = _encode_unique_tokens(
+        text_encoder, news_params, tokens_table, ids, dropout_rng
+    )
     cand_vecs = flat[: b * c].reshape(b, c, -1)
     his_vecs = flat[b * c :].reshape(b, h, -1)
     return cand_vecs, his_vecs
+
+
+def _encode_tokens_rows(
+    text_encoder: Any,
+    news_params: Any,
+    tokens_table: jnp.ndarray,
+    ids_2d: jnp.ndarray,
+    dropout_rng: jax.Array | None,
+) -> jnp.ndarray:
+    """Encode one (B, K) id block's unique news through the full TextEncoder.
+
+    Used under sequence parallelism in finetune mode, where candidates and
+    history must be encoded SEPARATELY: a joint ``jnp.unique`` over
+    candidates + the local history shard would place the same candidate news
+    at a different row index on each seq shard, giving it a different trunk
+    dropout mask despite the shared key — silently de-replicating the
+    candidate encode (and making the 1/n_seq grad correction inexact).
+    Encoding candidates alone keeps their row layout (and mask) identical on
+    every shard; history rows live on exactly one shard each, so their masks
+    are free to differ.
+    """
+    b, k = ids_2d.shape
+    flat = _encode_unique_tokens(
+        text_encoder, news_params, tokens_table, ids_2d.reshape(-1), dropout_rng
+    )
+    return flat.reshape(b, k, -1)
 
 
 def encode_corpus_tokens(
@@ -314,7 +356,22 @@ def build_fed_train_step(
             else:
 
                 def loss_fn(user_params, news_params):
-                    if mode == "finetune":
+                    if mode == "finetune" and n_seq > 1:
+                        # candidates and the local history shard are encoded
+                        # separately so the candidate row layout — and hence
+                        # its trunk dropout mask under the shared enc_rng —
+                        # is identical on every seq shard (see
+                        # _encode_tokens_rows)
+                        cand_vecs = _encode_tokens_rows(
+                            text_encoder, news_params, table,
+                            batch["candidates"], enc_rng,
+                        )
+                        his_vecs = _encode_tokens_rows(
+                            text_encoder, news_params, table,
+                            batch["history"],
+                            jax.random.fold_in(enc_rng, 1 + lax.axis_index(seq_ax)),
+                        )
+                    elif mode == "finetune":
                         # table = raw (N, 2, L) token rows; full trunk + head
                         # runs (and trains) on the batch's unique news
                         cand_vecs, his_vecs = _batch_news_vecs_tokens(

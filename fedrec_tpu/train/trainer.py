@@ -254,7 +254,7 @@ class Trainer:
         (identical across clients after a sync round)."""
         assert self.valid_ix is not None, "no validation samples"
         user_params, news_params = self._client0_params()
-        table = encode_all_news(self.model, news_params, self.token_states)
+        table = self._encode_corpus(news_params)
         vb = TrainBatcher(
             self.valid_ix,
             batch_size=min(len(self.valid_ix), 256),

@@ -242,6 +242,129 @@ def test_fed_train_step_seq_parallel_matches_plain():
         np.testing.assert_allclose(a, b, atol=2e-3)
 
 
+@pytest.mark.parametrize("dropout", [0.0, 0.2])
+def test_fed_train_step_seq_parallel_finetune(dropout):
+    """Finetune mode (full trunk in-loop) on a (2 clients x 4 seq) mesh.
+
+    With dropout off the sharded step must match the plain 2-client step
+    exactly (loss + updated trunk params). With dropout on, the candidate
+    encode is split from the history encode so its row layout — and dropout
+    mask — is identical on every shard (the round-1 divergence bug); here we
+    assert the step runs and stays finite.
+    """
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.fed import get_strategy
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.parallel import fed_mesh, shard_fed_batch
+    from fedrec_tpu.train import build_fed_train_step
+    from fedrec_tpu.train.state import init_client_state, replicate_state
+
+    def make_cfg(seq_shards):
+        cfg = ExperimentConfig()
+        cfg.model.news_dim = 32
+        cfg.model.num_heads = 4
+        cfg.model.head_dim = 8
+        cfg.model.query_dim = 16
+        cfg.model.bert_hidden = 32
+        cfg.model.dropout_rate = dropout
+        cfg.model.trunk_dropout = dropout
+        cfg.model.text_encoder_mode = "finetune"
+        cfg.model.trunk_layers = 1
+        cfg.model.trunk_heads = 2
+        cfg.model.trunk_ffn = 64
+        cfg.model.trunk_vocab = 500
+        cfg.data.max_his_len = 16
+        cfg.data.max_title_len = 8
+        cfg.data.batch_size = 4
+        cfg.fed.num_clients = 2
+        cfg.fed.seq_shards = seq_shards
+        return cfg
+
+    num_news, n_cli = 32, 2
+    rng = np.random.default_rng(3)
+    news_tokens = jnp.asarray(
+        rng.integers(1, 500, (num_news, 2, 8)).astype(np.int32)
+    )
+    raw_batch = {
+        "candidates": rng.integers(0, num_news, (n_cli, 4, 5)).astype(np.int32),
+        "history": rng.integers(0, num_news, (n_cli, 4, 16)).astype(np.int32),
+        "labels": np.zeros((n_cli, 4), np.int32),
+    }
+
+    results = {}
+    for seq_shards in (1, 4):
+        cfg = make_cfg(seq_shards)
+        model = NewsRecommender(cfg.model)
+        state0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, 8)
+        stacked = replicate_state(state0, n_cli, jax.random.PRNGKey(1))
+        mesh = fed_mesh(cfg)
+        batch = shard_fed_batch(mesh, raw_batch, cfg)
+        step = build_fed_train_step(
+            model, cfg, get_strategy("grad_avg"), mesh, mode="finetune"
+        )
+        new_state, metrics = step(stacked, batch, news_tokens)
+        results[seq_shards] = (
+            np.asarray(metrics["mean_loss"]),
+            jax.tree_util.tree_map(np.asarray, new_state.news_params),
+        )
+
+    loss1, news1 = results[1]
+    loss4, news4 = results[4]
+    assert np.all(np.isfinite(loss1)) and np.all(np.isfinite(loss4))
+    if dropout == 0.0:
+        np.testing.assert_allclose(loss4, loss1, atol=1e-5)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(news4), jax.tree_util.tree_leaves(news1)
+        ):
+            np.testing.assert_allclose(a, b, atol=2e-3)
+
+
+def test_finetune_candidate_encode_replicated_across_shards():
+    """The property behind the finetune seq-parallel fix: with trunk dropout
+    active and a SHARED key, encoding candidates alone gives bitwise-identical
+    vectors on every seq shard, while the old joint dedup (candidates + the
+    local history shard) places candidates at shard-dependent row indices and
+    de-replicates their dropout masks."""
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models.bert import make_text_encoder
+    from fedrec_tpu.train.step import _batch_news_vecs_tokens, _encode_tokens_rows
+
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.bert_hidden = 32
+    cfg.model.trunk_layers = 1
+    cfg.model.trunk_heads = 2
+    cfg.model.trunk_ffn = 64
+    cfg.model.trunk_vocab = 500
+    cfg.model.trunk_dropout = 0.2
+    cfg.data.max_title_len = 8
+    te = make_text_encoder(cfg.model)
+
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(1, 500, (32, 2, 8)).astype(np.int32))
+    params = te.init(jax.random.PRNGKey(0), jnp.zeros((1, 2, 8), jnp.int32))["params"]
+    cand = jnp.asarray(rng.integers(0, 32, (4, 5)).astype(np.int32))
+    # two different history shards, as two seq shards would see them
+    his_shards = [
+        jnp.asarray(rng.integers(0, 32, (4, 8)).astype(np.int32)) for _ in range(2)
+    ]
+    key = jax.random.PRNGKey(5)
+
+    # new path: candidates encoded alone -> identical on every "shard"
+    per_shard = [
+        np.asarray(_encode_tokens_rows(te, params, tokens, cand, key))
+        for _ in his_shards
+    ]
+    np.testing.assert_array_equal(per_shard[0], per_shard[1])
+
+    # old path: joint dedup with the local history shard -> masks diverge
+    joint = [
+        np.asarray(_batch_news_vecs_tokens(te, params, tokens, cand, h, key)[0])
+        for h in his_shards
+    ]
+    assert np.abs(joint[0] - joint[1]).max() > 1e-6
+
+
 def test_fed_train_step_seq_parallel_rejects_decoupled():
     from fedrec_tpu.config import ExperimentConfig
     from fedrec_tpu.fed import get_strategy

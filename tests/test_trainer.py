@@ -66,6 +66,69 @@ def test_trainer_runs_rounds(tmp_path, strategy, mode):
     assert history[-1].val_metrics and 0 <= history[-1].val_metrics["auc"] <= 1
 
 
+def finetune_cfg(tmp_path, **over) -> ExperimentConfig:
+    """Tiny-trunk finetune config (text_encoder_mode='finetune', 1-block
+    DistilBERT-shaped trunk) — BASELINE config 5 at test scale."""
+    cfg = tiny_cfg(tmp_path, **over)
+    cfg.model.text_encoder_mode = "finetune"
+    cfg.model.bert_hidden = 32
+    cfg.model.trunk_layers = 1
+    cfg.model.trunk_heads = 2
+    cfg.model.trunk_ffn = 64
+    cfg.model.trunk_vocab = 2000
+    cfg.fed.num_clients = 2
+    return cfg
+
+
+def finetune_data(cfg):
+    return make_synthetic_mind(
+        num_news=48, num_train=32, num_valid=8,
+        title_len=cfg.data.max_title_len, vocab=2000,
+        his_len_range=(2, cfg.data.max_his_len), seed=0,
+    )
+
+
+def test_trainer_finetune_round(tmp_path):
+    """In-loop trunk training end-to-end, INCLUDING evaluation (the round-1
+    crash: evaluate() read self.token_states, which is None in this mode)."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = finetune_cfg(tmp_path, fed__rounds=2)
+    data = finetune_data(cfg)
+    trainer = Trainer(cfg, data, token_states=None)
+    history = trainer.run()
+    assert len(history) == cfg.fed.rounds
+    assert all(np.isfinite(h.train_loss) for h in history)
+    m = history[-1].val_metrics
+    assert m and np.isfinite(m["loss"]) and 0 <= m["auc"] <= 1
+
+
+def test_trainer_finetune_resume_bit_identical(tmp_path):
+    """Finetune-mode snapshots round-trip the full trunk + opt state."""
+    import jax
+    from fedrec_tpu.train.trainer import Trainer
+
+    def flat_news(t):
+        return np.concatenate(
+            [np.ravel(x) for x in jax.tree_util.tree_leaves(t.state.news_params)]
+        )
+
+    cfg_a = finetune_cfg(tmp_path / "a", fed__rounds=2, train__save_every=1)
+    data = finetune_data(cfg_a)
+    t_a = Trainer(cfg_a, data, token_states=None)
+    t_a.run()
+
+    cfg_b = finetune_cfg(tmp_path / "b", fed__rounds=1, train__save_every=1)
+    Trainer(cfg_b, data, token_states=None).run()
+    cfg_b2 = finetune_cfg(tmp_path / "b", fed__rounds=2, train__save_every=1)
+    t_b2 = Trainer(cfg_b2, data, token_states=None)
+    assert t_b2.start_round == 1
+    t_b2.run()
+    np.testing.assert_allclose(
+        flat_news(t_a), flat_news(t_b2), rtol=1e-6, atol=1e-7
+    )
+
+
 def test_trainer_native_loader_round(tmp_path):
     """Full round with host batches assembled by the C++ engine."""
     from fedrec_tpu.data import native_batcher
