@@ -11,6 +11,16 @@ recommender (trainable text head over cached frozen-trunk token states +
 history, 50-token titles. The reference's federated deployment runs this math
 per-sample in torch/gloo on CPU nodes (reference ``README.md:13,86``,
 ``model.py:41-61``); ours is one jitted XLA program on the TPU chip.
+
+On TPU the run additionally reports:
+  * an analytic MFU estimate (the step's matmul FLOPs are statically known),
+  * a large-batch throughput (B=512 == the 8-client grad-avg equivalent:
+    with per-step gradient averaging all clients stay in lockstep, so 8
+    clients x B=64 on one chip is mathematically one B=512 step).
+
+The accelerator probe retries with backoff before falling back to CPU — the
+tunnel to the chip can be transiently wedged, and a CPU number must be the
+last resort, clearly labeled via the ``platform`` field.
 """
 
 from __future__ import annotations
@@ -24,31 +34,113 @@ from pathlib import Path
 
 import numpy as np
 
+_INNER = "FEDREC_BENCH_INNER"  # value: "tpu" | "cpu"
 
-def _device_init_hangs(timeout_s: int = 180) -> bool:
-    """Probe accelerator init in a subprocess (the axon TPU tunnel can wedge
-    indefinitely; a hung ``jax.devices()`` would otherwise eat the whole
-    bench budget). Returns True if init doesn't complete in time."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return proc.returncode != 0
-    except subprocess.TimeoutExpired:
-        return True
+# chip-name fragment -> (bf16 peak FLOP/s, f32 peak FLOP/s) per chip
+_PEAK_FLOPS = {
+    "v5 lite": (197e12, 49e12),   # v5e
+    "v5e": (197e12, 49e12),
+    "v4": (275e12, 137e12),
+    "v5p": (459e12, 229e12),
+    "v6": (918e12, 459e12),       # trillium
+}
+
+
+def _probe_accelerator(attempts: int = 3, timeout_s: int = 180) -> bool:
+    """True when ``jax.devices()`` initializes a non-CPU backend in time.
+
+    Runs in a subprocess (a wedged tunnel hangs the whole process, not just
+    the call) and retries with backoff — transient tunnel stalls are common.
+    """
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; d = jax.devices(); "
+                    "import sys; sys.exit(0 if d[0].platform != 'cpu' else 3)",
+                ],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                return True
+            if proc.returncode == 3:
+                return False  # definitive CPU-only answer; don't retry
+        except subprocess.TimeoutExpired:
+            pass
+        if i < attempts - 1:
+            time.sleep(10 * (i + 1))
+    return False
+
+
+def _reexec(platform: str) -> None:
+    """Re-exec the bench pinned to a platform, env hardened first."""
+    env = dict(os.environ)
+    env[_INNER] = platform
+    if platform == "cpu":
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
+        env["JAX_PLATFORMS"] = "cpu"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _flops_per_train_step(cfg, batch_size: int, num_news: int) -> float:
+    """Analytic matmul FLOPs for one joint-mode train step (fwd + bwd).
+
+    Counts the dominating dense ops; backward ~= 2x forward for matmuls.
+    """
+    B = batch_size
+    C = 1 + cfg.data.npratio
+    H = cfg.data.max_his_len
+    L = cfg.data.max_title_len
+    Dh = cfg.model.bert_hidden
+    D = cfg.model.news_dim
+    heads, dk = cfg.model.num_heads, cfg.model.head_dim
+    Q = cfg.model.query_dim
+
+    size = min(B * (C + H), num_news)  # unique-news slots encoded per step
+    att_hidden = Dh // 2               # text-head additive attention hidden
+    text = size * (2 * L * Dh * att_hidden + 2 * L * att_hidden + 2 * Dh * D)
+    mha = B * (3 * 2 * H * D * D + 2 * 2 * heads * H * H * dk + 2 * H * D)
+    pool = B * (2 * H * D * Q + 2 * H * Q)
+    score = B * 2 * C * D
+    fwd = text + mha + pool + score
+    return 3.0 * fwd  # fwd + ~2x fwd for backward
 
 
 def main() -> None:
-    if os.environ.get("FEDREC_BENCH_NO_PROBE") != "1" and _device_init_hangs():
-        # re-exec on CPU so the contract (one JSON line) still holds; the
-        # platform field records that this was a fallback run
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
-        env["JAX_PLATFORMS"] = "cpu"
-        env["FEDREC_BENCH_NO_PROBE"] = "1"
-        os.execve(sys.executable, [sys.executable, __file__], env)
+    inner = os.environ.get(_INNER)
+    if inner is None:
+        if _probe_accelerator():
+            # run the TPU bench under a watchdog: a post-probe wedge (e.g. a
+            # tunnel stall at compile time) must still end in a JSON line
+            env = dict(os.environ)
+            env[_INNER] = "tpu"
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, timeout=1200, capture_output=True, text=True,
+                )
+                line = next(
+                    (
+                        ln
+                        for ln in reversed(proc.stdout.splitlines())
+                        if ln.startswith("{")
+                    ),
+                    None,
+                )
+                if proc.returncode == 0 and line:
+                    print(line)
+                    return
+                sys.stderr.write(
+                    f"[bench] tpu run failed (rc={proc.returncode}); cpu fallback\n"
+                )
+                if proc.stderr:
+                    sys.stderr.write(proc.stderr[-2000:] + "\n")
+            except subprocess.TimeoutExpired:
+                sys.stderr.write("[bench] tpu run timed out; cpu fallback\n")
+        _reexec("cpu")
 
     import jax
     import jax.numpy as jnp
@@ -60,11 +152,15 @@ def main() -> None:
     from fedrec_tpu.train import build_fed_train_step
     from fedrec_tpu.train.state import init_client_state, replicate_state
 
-    platform = jax.devices()[0].platform
+    device = jax.devices()[0]
+    platform = device.platform
+    on_tpu = platform != "cpu"
 
     cfg = ExperimentConfig()
     cfg.fed.num_clients = 1
     cfg.data.batch_size = 64
+    if on_tpu:
+        cfg.model.dtype = "bfloat16"  # MXU-native; params/opt stay f32
     num_news, L = 4096, cfg.data.max_title_len
     B, C, H = cfg.data.batch_size, 1 + cfg.data.npratio, cfg.data.max_his_len
 
@@ -73,58 +169,69 @@ def main() -> None:
         rng.standard_normal((num_news, L, cfg.model.bert_hidden)).astype(np.float32)
     )
     model = NewsRecommender(cfg.model)
-    state0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, L)
-    stacked = replicate_state(state0, 1, jax.random.PRNGKey(1))
     mesh = client_mesh(1)
     step = build_fed_train_step(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
 
-    def make_batch(seed: int):
+    def make_batch(seed: int, bsz: int):
         r = np.random.default_rng(seed)
         return shard_batch(
             mesh,
             {
-                "candidates": r.integers(0, num_news, (1, B, C)).astype(np.int32),
-                "history": r.integers(0, num_news, (1, B, H)).astype(np.int32),
-                "labels": np.zeros((1, B), np.int32),
+                "candidates": r.integers(0, num_news, (1, bsz, C)).astype(np.int32),
+                "history": r.integers(0, num_news, (1, bsz, H)).astype(np.int32),
+                "labels": np.zeros((1, bsz), np.int32),
             },
         )
 
-    batches = [make_batch(s) for s in range(8)]
+    def measure(bsz: int, iters: int, warmup: int = 3):
+        state0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, L)
+        stacked = replicate_state(state0, 1, jax.random.PRNGKey(1))
+        batches = [make_batch(s, bsz) for s in range(8)]
+        for i in range(warmup):
+            stacked, metrics = step(stacked, batches[i % 8], token_states)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for i in range(iters):
+            stacked, metrics = step(stacked, batches[i % 8], token_states)
+        jax.block_until_ready(metrics["loss"])
+        return (time.perf_counter() - t0) / iters
 
-    # warmup / compile
-    for i in range(3):
-        stacked, metrics = step(stacked, batches[i % 8], token_states)
-    jax.block_until_ready(metrics["loss"])
-
-    iters = 20
-    t0 = time.perf_counter()
-    for i in range(iters):
-        stacked, metrics = step(stacked, batches[i % 8], token_states)
-    jax.block_until_ready(metrics["loss"])
-    dt = (time.perf_counter() - t0) / iters
-
+    dt = measure(B, iters=50 if on_tpu else 20)
     samples_per_sec = B / dt
 
+    out = {
+        "metric": "fedrec_train_step_throughput",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "device": getattr(device, "device_kind", platform),
+        "dtype": cfg.model.dtype,
+        "sec_per_step": round(dt, 6),
+        "batch_size": B,
+        "baseline": "torch-cpu reference-equivalent, see benchmarks/baseline_host.json",
+    }
+
     baseline_path = Path(__file__).parent / "benchmarks" / "baseline_host.json"
-    vs_baseline = None
     if baseline_path.exists():
         base = json.loads(baseline_path.read_text())
-        vs_baseline = samples_per_sec / base["samples_per_sec"]
+        out["vs_baseline"] = round(samples_per_sec / base["samples_per_sec"], 2)
 
-    print(
-        json.dumps(
-            {
-                "metric": "fedrec_train_step_throughput",
-                "value": round(samples_per_sec, 2),
-                "unit": "samples/sec",
-                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
-                "platform": platform,
-                "sec_per_step": round(dt, 6),
-                "batch_size": B,
-                "baseline": "torch-cpu reference-equivalent, see benchmarks/baseline_host.json",
-            }
-        )
-    )
+    if on_tpu:
+        flops = _flops_per_train_step(cfg, B, num_news)
+        kind = getattr(device, "device_kind", "").lower()
+        for frag, (peak_bf16, peak_f32) in _PEAK_FLOPS.items():
+            if frag in kind:
+                peak = peak_bf16 if cfg.model.dtype == "bfloat16" else peak_f32
+                out["mfu_estimate"] = round(flops / dt / peak, 4)
+                out["flops_per_step"] = flops
+                break
+        # 8-client grad-avg equivalent: one lockstep B=512 step on this chip
+        B8 = 8 * B
+        dt8 = measure(B8, iters=20)
+        out["clients8_samples_per_sec"] = round(B8 / dt8, 2)
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,130 @@
+"""Microbenchmark: Pallas fused kernels vs the XLA (jnp) path, on TPU.
+
+Times the two hot attention ops at reference scale (H=50) and long-context
+scale (H=1024), forward and forward+backward:
+
+  * flash_attention  vs dense jnp scaled-dot-product attention
+  * additive_pool    vs the jnp additive-attention math
+
+Emits one markdown table (stdout) plus ``benchmarks/pallas_bench.json``.
+The ``model.use_pallas`` default should follow this table: enable the
+kernels only where they beat XLA on real hardware (VERDICT round 1, item 5).
+
+Off-TPU the kernels run in interpret mode, which measures nothing useful —
+the script refuses to run unless a TPU backend is live (or --force).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def _time(fn, *args, iters: int = 30, warmup: int = 5) -> float:
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--force", action="store_true", help="run off-TPU anyway")
+    parser.add_argument("--batch", type=int, default=64)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.ops.attention_kernels import additive_pool, flash_attention
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not args.force:
+        print("refusing to microbench Pallas kernels off-TPU (interpret mode); "
+              "pass --force to override")
+        return 1
+
+    B, heads, dk, D, hidden = args.batch, 20, 20, 400, 200
+    rows = []
+
+    for H in (50, 1024):
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((B, H, heads, dk)).astype(np.float32))
+        k = jnp.asarray(rng.standard_normal((B, H, heads, dk)).astype(np.float32))
+        v = jnp.asarray(rng.standard_normal((B, H, heads, dk)).astype(np.float32))
+        mask = jnp.asarray((rng.random((B, H)) > 0.1).astype(np.float32))
+
+        def dense_attn(q, k, v, mask):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(dk))
+            s = jnp.where(mask[:, None, None, :] > 0, s, -1e9)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+        pallas_attn = jax.jit(lambda q, k, v, m: flash_attention(q, k, v, m))
+        xla_attn = jax.jit(dense_attn)
+
+        def g_pallas(q, k, v, m):
+            return jax.grad(lambda q: flash_attention(q, k, v, m).sum())(q)
+
+        def g_xla(q, k, v, m):
+            return jax.grad(lambda q: dense_attn(q, k, v, m).sum())(q)
+
+        rows.append(("flash_attention fwd", H,
+                     _time(xla_attn, q, k, v, mask),
+                     _time(pallas_attn, q, k, v, mask)))
+        rows.append(("flash_attention fwd+bwd", H,
+                     _time(jax.jit(g_xla), q, k, v, mask),
+                     _time(jax.jit(g_pallas), q, k, v, mask)))
+
+        x = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
+        w1 = jnp.asarray(rng.standard_normal((D, hidden)).astype(np.float32) * 0.05)
+        b1 = jnp.zeros((hidden,), jnp.float32)
+        w2 = jnp.asarray(rng.standard_normal((hidden,)).astype(np.float32) * 0.05)
+
+        def dense_pool(x, w1, b1, w2, mask):
+            e = jnp.tanh(jnp.einsum("nld,dh->nlh", x, w1) + b1)
+            logits = jnp.einsum("nlh,h->nl", e, w2) + jnp.where(mask > 0, 0.0, -1e9)
+            alpha = jax.nn.softmax(logits, axis=-1)
+            return jnp.einsum("nl,nld->nd", alpha, x)
+
+        pallas_pool = jax.jit(lambda x, m: additive_pool(x, w1, b1, w2, m))
+        xla_pool = jax.jit(lambda x, m: dense_pool(x, w1, b1, w2, m))
+        rows.append(("additive_pool fwd", H,
+                     _time(xla_pool, x, mask),
+                     _time(pallas_pool, x, mask)))
+        rows.append((
+            "additive_pool fwd+bwd", H,
+            _time(jax.jit(lambda x, m: jax.grad(
+                lambda x: dense_pool(x, w1, b1, w2, m).sum())(x)), x, mask),
+            _time(jax.jit(lambda x, m: jax.grad(
+                lambda x: additive_pool(x, w1, b1, w2, m).sum())(x)), x, mask),
+        ))
+
+    print(f"\n## Pallas vs XLA on {platform} "
+          f"({getattr(jax.devices()[0], 'device_kind', '?')}), B={B}\n")
+    print("| op | H | xla ms | pallas ms | pallas/xla |")
+    print("|---|---|---|---|---|")
+    out = []
+    for name, H, t_x, t_p in rows:
+        print(f"| {name} | {H} | {t_x*1e3:.3f} | {t_p*1e3:.3f} | {t_p/t_x:.2f}x |")
+        out.append({"op": name, "H": H, "xla_ms": t_x * 1e3,
+                    "pallas_ms": t_p * 1e3, "ratio": t_p / t_x})
+
+    Path(__file__).with_name("pallas_bench.json").write_text(
+        json.dumps({"platform": platform, "batch": B, "rows": out}, indent=2)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

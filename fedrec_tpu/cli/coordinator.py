@@ -40,6 +40,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--process-id", type=int, default=None)
     parser.add_argument("--server-trains", action="store_true",
                         help="process 0 also trains (reference server does not)")
+    parser.add_argument("--collective-timeout", type=float, default=300.0,
+                        help="seconds before a hung DCN collective marks the "
+                             "world broken and this host finishes standalone "
+                             "(0 = wait forever, the reference's behavior)")
     args = parser.parse_args(argv)
 
     from fedrec_tpu.parallel.multihost import (
@@ -57,7 +61,7 @@ def main(argv: list[str] | None = None) -> int:
     from fedrec_tpu.privacy import calibrate_sigma
     from fedrec_tpu.train.trainer import Trainer
 
-    rt = CoordinatorRuntime()
+    rt = CoordinatorRuntime(collective_timeout_s=args.collective_timeout or None)
 
     cfg = ExperimentConfig()
     cfg.fed.rounds = args.total_epochs
@@ -98,17 +102,41 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
+    local_snap = None
     if rt.num_processes > 1:
-        # orbax snapshots need whole-world coordination; in the coordinator
-        # deployment the server instead persists the global model per round
-        # (the reference's model.pt / received_model_{i}.pt artifacts,
-        # client.py:288 / server.py:27)
+        # orbax snapshots assume whole-world coordination; in the coordinator
+        # deployment each process instead flax-serializes its FULL local
+        # state (params + opt state + PRNG) per save cadence, and the server
+        # additionally persists the global model per round (the reference's
+        # model.pt / received_model_{i}.pt artifacts, client.py:288 /
+        # server.py:27 — which lose client opt state on restart; ours don't)
         snapshot_dir = Path(cfg.train.snapshot_dir or "snapshots")
         cfg.train.snapshot_dir = ""
     trainer = Trainer(cfg, data, token_states)
 
+    if rt.num_processes > 1:
+        from flax import serialization
+
+        local_snap = snapshot_dir / f"local_state_p{rt.process_id}.msgpack"
+        if cfg.train.resume and local_snap.exists():
+            template = {"state": trainer.state, "round": 0}
+            restored = serialization.from_bytes(template, local_snap.read_bytes())
+            trainer.adopt_state(restored["state"])
+            trainer.start_round = int(restored["round"]) + 1
+            print(
+                f"[coordinator] process {rt.process_id} resumed local state "
+                f"at round {trainer.start_round - 1}"
+            )
+
     round_idx = trainer.start_round
-    while rt.start_round(round_idx, cfg.fed.rounds):
+    while True:
+        # negotiate the round: everyone adopts the SERVER's counter (a host
+        # resumed from a stale snapshot would otherwise desync batch seeds,
+        # save cadence, and snapshot labels)
+        server_round = rt.start_round(round_idx, cfg.fed.rounds)
+        if server_round < 0:
+            break
+        round_idx = server_round
         # server fan-out: everyone adopts the global model
         u0, n0 = trainer._client0_params()
         u, n = rt.sync_from_server((u0, n0))
@@ -130,16 +158,26 @@ def main(argv: list[str] | None = None) -> int:
         if (round_idx + 1) % cfg.train.save_every == 0:
             if trainer.snapshots is not None:
                 trainer.snapshots.save(round_idx, trainer.state)
-            elif rt.is_server:
+            elif local_snap is not None:
                 from flax import serialization
 
                 snapshot_dir.mkdir(parents=True, exist_ok=True)
-                (snapshot_dir / f"global_round_{round_idx}.msgpack").write_bytes(
-                    serialization.to_bytes({"user": u, "news": n, "round": round_idx})
+                local_snap.write_bytes(
+                    serialization.to_bytes(
+                        {"state": trainer.state, "round": round_idx}
+                    )
                 )
+                if rt.is_server:
+                    (snapshot_dir / f"global_round_{round_idx}.msgpack").write_bytes(
+                        serialization.to_bytes(
+                            {"user": u, "news": n, "round": round_idx}
+                        )
+                    )
         round_idx += 1
 
     print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
+    trainer.logger.finish()  # before finalize: os._exit skips teardown
+    rt.finalize(0)  # no-op unless the world broke mid-run (then exits here)
     return 0
 
 

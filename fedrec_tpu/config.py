@@ -122,6 +122,12 @@ class TrainConfig:
     snapshot_dir: str = "snapshots"
     resume: bool = True                # auto-resume if snapshot exists (main.py:113-115)
     eval_every: int = 1
+    # "sampled" — 1 pos + npratio sampled negatives per impression (the
+    #             reference's per-epoch validate, client.py:149-171)
+    # "full"    — deterministic full-negative-pool scoring (the protocol
+    #             behind the published MIND table, evaluation_functions.py:33-47)
+    # "last4"   — deterministic last-4-pool-negatives slice (client.py:159-160)
+    eval_protocol: str = "full"
     log_every: int = 10
     seed: int = 42
     profile: bool = False              # jax.profiler trace around the hot loop

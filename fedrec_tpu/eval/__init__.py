@@ -5,6 +5,7 @@ from fedrec_tpu.eval.metrics import (
     evaluation_split,
     mrr_score,
     ndcg_score,
+    full_pool_metrics_batch,
     ranking_metrics_batch,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "evaluation_split",
     "mrr_score",
     "ndcg_score",
+    "full_pool_metrics_batch",
     "ranking_metrics_batch",
 ]

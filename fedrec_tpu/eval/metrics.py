@@ -165,3 +165,41 @@ def ranking_metrics_batch(scores: jnp.ndarray, positive_index: int = 0) -> dict:
     ndcg5 = jnp.where(rank <= 5, ndcg, 0.0)
     ndcg10 = jnp.where(rank <= 10, ndcg, 0.0)
     return {"auc": auc, "mrr": mrr, "ndcg5": ndcg5, "ndcg10": ndcg10}
+
+
+def full_pool_metrics_batch(
+    pos_scores: jnp.ndarray,
+    neg_scores: jnp.ndarray,
+    neg_mask: jnp.ndarray,
+) -> dict:
+    """Per-impression AUC/MRR/NDCG over each impression's FULL negative pool.
+
+    ``pos_scores``: (B,) the single positive's score per impression.
+    ``neg_scores``: (B, P) scores over the padded negative pool.
+    ``neg_mask``:   (B, P) 1.0 for real negatives, 0.0 for padding.
+
+    Deterministic full-pool evaluation — the reference's published MIND
+    numbers are full-pool (``evaluation_split``, reference
+    ``evaluation_functions.py:33-47``), not npratio-sampled. With one
+    positive the closed forms still hold with n_neg = mask sum:
+
+      rank r   = 1 + #{real negatives with score >= positive}
+      AUC      = (n_neg - (r - 1)) / n_neg
+      MRR      = 1 / r
+      NDCG@k   = 1/log2(r+1) if r <= k else 0   (ideal DCG = 1)
+
+    Impressions with zero real negatives get AUC 0 and must be masked out by
+    the caller (the reference skips them via try/except).
+    """
+    pos = jnp.asarray(pos_scores)[:, None]
+    neg = jnp.asarray(neg_scores)
+    mask = jnp.asarray(neg_mask, jnp.float32)
+    n_neg = jnp.sum(mask, axis=1)
+    beaten_by = jnp.sum((neg >= pos) * mask, axis=1)
+    rank = 1.0 + beaten_by
+    auc = jnp.where(n_neg > 0, (n_neg - beaten_by) / jnp.maximum(n_neg, 1.0), 0.0)
+    mrr = 1.0 / rank
+    ndcg = 1.0 / jnp.log2(rank + 1.0)
+    ndcg5 = jnp.where(rank <= 5, ndcg, 0.0)
+    ndcg10 = jnp.where(rank <= 10, ndcg, 0.0)
+    return {"auc": auc, "mrr": mrr, "ndcg5": ndcg5, "ndcg10": ndcg10}

@@ -32,15 +32,21 @@ def score_candidates(cand_vecs: jnp.ndarray, user_vec: jnp.ndarray) -> jnp.ndarr
 
 
 def score_loss(
-    scores: jnp.ndarray, labels: jnp.ndarray, sigmoid_before_ce: bool = True
+    scores: jnp.ndarray,
+    labels: jnp.ndarray,
+    sigmoid_before_ce: bool = True,
+    reduce: bool = True,
 ) -> jnp.ndarray:
-    """Mean cross-entropy over impressions (labels are always slot 0).
+    """Cross-entropy over impressions (labels are always slot 0).
 
     ``sigmoid_before_ce=True`` reproduces reference ``model.py:123-126``:
-    ``CrossEntropyLoss()(sigmoid(scores), labels)``.
+    ``CrossEntropyLoss()(sigmoid(scores), labels)``. ``reduce=False``
+    returns the per-impression vector (used by evaluation to trim batch
+    padding before averaging).
     """
     logits = nn.sigmoid(scores) if sigmoid_before_ce else scores
-    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+    per_row = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(per_row) if reduce else per_row
 
 
 class NewsRecommender(nn.Module):

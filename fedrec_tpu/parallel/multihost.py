@@ -24,7 +24,8 @@ reference instead hangs until its 2-day gloo timeout if any client dies
 
 from __future__ import annotations
 
-from typing import Any
+import threading
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,19 @@ def broadcast_round_flag(keep_going: bool) -> bool:
     return bool(float(flag) != 0.0)
 
 
+def broadcast_round_index(round_idx: int) -> int:
+    """Server -> clients round counter; -1 = stop.
+
+    Subsumes the reference's 1.0/0.0 flag (``server.py:74,105``) AND pins
+    every host to the server's round index — a client resumed from a stale
+    (or missing) local snapshot would otherwise run a different counter than
+    the server: different batch seeds, misaligned save cadence, mislabeled
+    global snapshots.
+    """
+    v = multihost_utils.broadcast_one_to_all(jnp.asarray(round_idx, jnp.int32))
+    return int(v)
+
+
 def aggregate_from_hosts(params: Any, weight: float = 1.0) -> Any:
     """Participation-weighted FedAvg across processes.
 
@@ -87,27 +101,104 @@ class CoordinatorRuntime:
     1 as the source, ``client.py:257`` — an arbitrary choice; we use 0).
     Single-process fallback: all methods degrade to no-ops so the same
     driver script runs standalone.
+
+    Unplanned-failure tolerance (``collective_timeout_s``): every DCN
+    collective runs under a watchdog. A dead peer hangs the collective for
+    every survivor (and would hang every subsequent one too), so on the
+    first timeout or collective error the runtime flips to ``degraded``
+    mode: all later calls take the local path and the host finishes its
+    remaining rounds standalone. The reference instead blocks until its
+    2-day gloo timeout and then dies (``client.py:227``,
+    Final_Report.pdf VII.a). Planned per-round sit-outs don't need this —
+    they are weight-0 participation in :meth:`aggregate`.
     """
 
-    def __init__(self):
+    def __init__(self, collective_timeout_s: float | None = None):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
+        self.collective_timeout_s = collective_timeout_s
+        self.degraded = False
 
     @property
     def is_server(self) -> bool:
         return self.process_id == 0
 
-    def start_round(self, round_idx: int, total_rounds: int) -> bool:
+    def _collective(self, fn: Callable[[], Any], fallback: Callable[[], Any]) -> Any:
+        """Run one DCN collective under the watchdog; local fallback after
+        the world is known-broken. The abandoned worker thread stays blocked
+        in the dead collective — it is a daemon and never rejoined."""
+        if self.degraded:
+            return fallback()
+        if not self.collective_timeout_s:
+            return fn()
+        box: list = []
+        errs: list = []
+
+        def target():
+            try:
+                box.append(fn())
+            except Exception as exc:  # collective error == peer failure
+                errs.append(exc)
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(self.collective_timeout_s)
+        if t.is_alive() or errs:
+            why = f"error: {errs[0]!r}" if errs else (
+                f"timeout after {self.collective_timeout_s}s"
+            )
+            print(
+                f"[multihost] process {self.process_id}: collective failed "
+                f"({why}); degrading to standalone training for the "
+                "remaining rounds"
+            )
+            self.degraded = True
+            return fallback()
+        return box[0]
+
+    def start_round(self, round_idx: int, total_rounds: int) -> int:
+        """Negotiate the next round: returns the SERVER's round index, or -1
+        to stop. Clients must adopt the returned counter (their own may be
+        stale after a partial-snapshot resume). Locally (single process or
+        degraded) it is the caller's own counter that decides."""
+        local = round_idx if round_idx < total_rounds else -1
         if self.num_processes == 1:
-            return round_idx < total_rounds
-        return broadcast_round_flag(round_idx < total_rounds)
+            return local
+        return self._collective(
+            lambda: broadcast_round_index(local if self.is_server else 0),
+            lambda: local,
+        )
 
     def sync_from_server(self, params: Any) -> Any:
         if self.num_processes == 1:
             return params
-        return broadcast_params(params, is_source=self.is_server)
+        return self._collective(
+            lambda: broadcast_params(params, is_source=self.is_server),
+            lambda: params,
+        )
 
     def aggregate(self, params: Any, participated: bool = True) -> Any:
         if self.num_processes == 1:
             return params
-        return aggregate_from_hosts(params, 1.0 if participated else 0.0)
+        return self._collective(
+            lambda: aggregate_from_hosts(params, 1.0 if participated else 0.0),
+            lambda: params,
+        )
+
+    def finalize(self, exit_code: int = 0) -> None:
+        """Call after the round loop, once all artifacts are flushed.
+
+        In degraded mode the coordination service is broken: any shutdown
+        barrier — including the one the distributed client's destructor runs
+        at interpreter teardown — either hangs or terminates the process
+        with a fatal coordination-service error. The only clean exit is to
+        skip teardown entirely. No-op while the world is intact.
+        """
+        if not self.degraded:
+            return
+        import os
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(exit_code)

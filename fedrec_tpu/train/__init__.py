@@ -2,6 +2,7 @@ from fedrec_tpu.train.state import ClientState, init_client_state, stack_states
 from fedrec_tpu.train.step import (
     build_eval_step,
     build_fed_train_step,
+    build_full_eval_step,
     build_news_update_step,
     build_param_sync,
     encode_all_news,
@@ -10,6 +11,7 @@ from fedrec_tpu.train.step import (
 __all__ = [
     "ClientState",
     "build_eval_step",
+    "build_full_eval_step",
     "build_fed_train_step",
     "build_news_update_step",
     "build_param_sync",

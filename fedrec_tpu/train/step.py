@@ -604,10 +604,12 @@ def build_param_sync(
 def build_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
     """Per-impression validation metrics on device.
 
-    ``evaluate(user_params, news_vecs_table, batch) -> dict`` scoring
-    candidates by dot product (reference ``Trainer.validate``,
-    ``client.py:149-171``) — but returning the MEAN over impressions, fixing
-    the reference's last-sample-only bug (``client.py:171``).
+    ``evaluate(user_params, news_vecs_table, batch) -> dict of (B,) arrays``
+    scoring candidates by dot product (reference ``Trainer.validate``,
+    ``client.py:149-171``). Returns PER-IMPRESSION vectors (incl. per-row
+    loss) so the caller can trim batch padding before averaging — fixing
+    both the reference's last-sample-only bug (``client.py:171``) and the
+    wrap-around-pad double count of a naive batch mean.
     """
 
     def evaluate(user_params, news_vecs, batch):
@@ -619,10 +621,36 @@ def build_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
             method=NewsRecommender.encode_user,
         )
         scores = score_candidates(cand_vecs, user_vec)
-        loss = score_loss(scores, batch["labels"], cfg.model.sigmoid_before_ce)
-        metrics = ranking_metrics_batch(scores)
-        out = {k: jnp.mean(v) for k, v in metrics.items()}
-        out["loss"] = loss
+        out = dict(ranking_metrics_batch(scores))
+        out["loss"] = score_loss(
+            scores, batch["labels"], cfg.model.sigmoid_before_ce, reduce=False
+        )
         return out
+
+    return jax.jit(evaluate)
+
+
+def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
+    """Deterministic FULL-POOL evaluation step.
+
+    ``evaluate(user_params, news_vecs_table, batch) -> dict of (B,) arrays``
+    where ``batch`` holds per-impression ``pos`` (B,), padded negative pools
+    ``neg_pools`` (B, P) with ``neg_mask`` (B, P), and ``history`` (B, H).
+    Scores every real pool negative against the one positive — the protocol
+    behind the reference's published MIND table (``evaluation_split``,
+    reference ``evaluation_functions.py:33-47``), with no sampling noise.
+    """
+    from fedrec_tpu.eval.metrics import full_pool_metrics_batch
+
+    def evaluate(user_params, news_vecs, batch):
+        his_vecs = news_vecs[batch["history"]]
+        user_vec = model.apply(
+            {"params": {"user_encoder": user_params}},
+            his_vecs,
+            method=NewsRecommender.encode_user,
+        )  # (B, D)
+        pos_scores = jnp.einsum("bd,bd->b", news_vecs[batch["pos"]], user_vec)
+        neg_scores = jnp.einsum("bpd,bd->bp", news_vecs[batch["neg_pools"]], user_vec)
+        return full_pool_metrics_batch(pos_scores, neg_scores, batch["neg_mask"])
 
     return jax.jit(evaluate)

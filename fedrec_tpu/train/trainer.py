@@ -44,6 +44,7 @@ from fedrec_tpu.train.state import init_client_state, replicate_state
 from fedrec_tpu.train.step import (
     build_eval_step,
     build_fed_train_step,
+    build_full_eval_step,
     build_news_update_step,
     build_param_sync,
     encode_all_news,
@@ -82,6 +83,11 @@ class Trainer:
         self.mode = {"table": "decoupled", "head": "joint", "finetune": "finetune"}.get(
             cfg.model.text_encoder_mode, "joint"
         )
+        if cfg.train.eval_protocol not in ("sampled", "full", "last4"):
+            raise ValueError(
+                f"unknown train.eval_protocol {cfg.train.eval_protocol!r}; "
+                "expected 'sampled', 'full', or 'last4'"
+            )
 
         self.text_encoder = None
         self.news_tokens: jnp.ndarray | None = None
@@ -129,6 +135,7 @@ class Trainer:
         )
         self.param_sync = build_param_sync(cfg, self.mesh, self.strategy)
         self.eval_step = build_eval_step(self.model, cfg)
+        self.full_eval_step = build_full_eval_step(self.model, cfg)
 
         # state (pre-sharded so the first step doesn't retrace)
         state0 = init_client_state(
@@ -167,6 +174,15 @@ class Trainer:
         u = jax.tree_util.tree_map(lambda x: x[0], self.state.user_params)
         n = jax.tree_util.tree_map(lambda x: x[0], self.state.news_params)
         return u, n
+
+    def adopt_state(self, state: Any) -> None:
+        """Install a restored full state pytree (params + opt + PRNG) with
+        the trainer's client sharding — the multi-process resume path, where
+        snapshots are flax-serialized per host rather than orbax-managed."""
+        sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
+        self.state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding), state
+        )
 
     def set_global_params(self, user_params: Any, news_params: Any) -> None:
         """Adopt externally-aggregated parameters on every local client.
@@ -245,19 +261,34 @@ class Trainer:
         train_loss = float(np.mean([np.mean(np.asarray(l)) for l in losses]))
         result = RoundResult(round_idx, train_loss)
         if self.valid_ix is not None and (round_idx + 1) % self.cfg.train.eval_every == 0:
-            result.val_metrics = self.evaluate()
+            protocol = self.cfg.train.eval_protocol  # validated in __init__
+            if protocol == "full":
+                result.val_metrics = self.evaluate_full()
+            elif protocol == "last4":
+                result.val_metrics = self.evaluate_full(last_k=4)
+            else:
+                result.val_metrics = self.evaluate()
         return result
 
     def evaluate(self) -> dict[str, float]:
         """Mean validation metrics over all impressions (fixes the reference's
         last-sample-only bug, ``client.py:171``) using client-0 parameters
-        (identical across clients after a sync round)."""
+        (identical across clients after a sync round).
+
+        Candidates are 1 positive + ``npratio`` sampled negatives (the
+        reference's per-epoch ``validate``, ``client.py:149-171``); batches
+        keep one static shape, with the final batch's wrap-around padding
+        trimmed from the mean. For the deterministic published-table protocol
+        use :meth:`evaluate_full`.
+        """
         assert self.valid_ix is not None, "no validation samples"
         user_params, news_params = self._client0_params()
         table = self._encode_corpus(news_params)
+        n = len(self.valid_ix)
+        bsz = min(n, 256)
         vb = TrainBatcher(
             self.valid_ix,
-            batch_size=min(len(self.valid_ix), 256),
+            batch_size=bsz,
             npratio=self.cfg.data.npratio,
             shuffle=False,
             drop_remainder=False,
@@ -275,11 +306,78 @@ class Trainer:
                     "labels": batch.labels,
                 },
             )
-            bsz = batch.candidates.shape[0]
+            valid_n = min(bsz, n - count)  # trim wrap-around pad rows
             for k, v in out.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * bsz
-            count += bsz
+                sums[k] = sums.get(k, 0.0) + float(jnp.sum(v[:valid_n]))
+            count += valid_n
         return {k: v / count for k, v in sums.items()}
+
+    def evaluate_full(self, last_k: int | None = None) -> dict[str, float]:
+        """Deterministic evaluation over each impression's FULL negative pool.
+
+        The protocol behind the reference's published MIND table (AUC 68.42
+        etc. — full-pool ``evaluation_split``, reference
+        ``evaluation_functions.py:33-47``). ``last_k`` keeps only each pool's
+        LAST k negatives — ``last_k=4`` reproduces the reference client's
+        deterministic per-round validation slice (``client.py:159-160``).
+
+        Impressions with an empty (post-slice) pool are skipped, as the
+        reference's try/except does. One compile: static (B, P) shapes with
+        padding masked out of every mean.
+        """
+        assert self.valid_ix is not None, "no validation samples"
+        user_params, news_params = self._client0_params()
+        table = self._encode_corpus(news_params)
+
+        ix = self.valid_ix
+        n = len(ix)
+        pools = ix.neg_pools
+        lens = ix.neg_lens.astype(np.int64)
+        if last_k is not None:
+            # keep each pool's last k real negatives, left-aligned: row i
+            # becomes pools[i, max(0, len-k) : len] (+ right padding)
+            p = min(last_k, pools.shape[1])
+            start = np.maximum(lens - p, 0)[:, None]
+            idx = np.minimum(start + np.arange(p)[None, :], pools.shape[1] - 1)
+            pools = np.take_along_axis(pools, idx, axis=1)
+            lens = np.minimum(lens, p)
+        P = max(1, pools.shape[1])
+        mask = (np.arange(P)[None, :] < lens[:, None]).astype(np.float32)
+
+        bsz = min(n, 256)
+        pad = (-n) % bsz
+        def _pad(a):
+            return np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) if pad else a
+
+        pos_a = _pad(ix.pos)
+        pools_a = _pad(pools.astype(np.int32))
+        mask_a = _pad(mask)
+        his_a = _pad(ix.history)
+        keep_a = _pad((lens > 0).astype(np.float32))
+        if pad:
+            keep_a[n:] = 0.0  # padded rows never count
+
+        sums = {k: 0.0 for k in ("auc", "mrr", "ndcg5", "ndcg10")}
+        kept = 0.0
+        for b in range(0, n + pad, bsz):
+            sl = slice(b, b + bsz)
+            out = self.full_eval_step(
+                user_params,
+                table,
+                {
+                    "pos": pos_a[sl],
+                    "neg_pools": pools_a[sl],
+                    "neg_mask": mask_a[sl],
+                    "history": his_a[sl],
+                },
+            )
+            w = keep_a[sl]
+            for k in sums:
+                sums[k] += float(jnp.sum(out[k] * w))
+            kept += float(w.sum())
+        if kept == 0:
+            raise ValueError("no impression has a non-empty negative pool")
+        return {k: v / kept for k, v in sums.items()}
 
     # ------------------------------------------------------------------
     def run(self) -> list[RoundResult]:
@@ -291,15 +389,16 @@ class Trainer:
                 history.append(result)
                 log = {"round": round_idx, "training_loss": result.train_loss}
                 if result.val_metrics:
-                    log.update(
-                        {
-                            "validation_loss": result.val_metrics.get("loss"),
-                            "valid_auc": result.val_metrics.get("auc"),
-                            "valid_mrr": result.val_metrics.get("mrr"),
-                            "val_ndcg@5": result.val_metrics.get("ndcg5"),
-                            "val_ndcg@10": result.val_metrics.get("ndcg10"),
-                        }
-                    )
+                    named = {
+                        "validation_loss": result.val_metrics.get("loss"),
+                        "valid_auc": result.val_metrics.get("auc"),
+                        "valid_mrr": result.val_metrics.get("mrr"),
+                        "val_ndcg@5": result.val_metrics.get("ndcg5"),
+                        "val_ndcg@10": result.val_metrics.get("ndcg10"),
+                    }
+                    # the full-pool protocols have no loss key — omit, don't
+                    # log null
+                    log.update({k: v for k, v in named.items() if v is not None})
                 self.logger.log(round_idx, log)
                 if self.snapshots is not None and (
                     (round_idx + 1) % cfg.train.save_every == 0

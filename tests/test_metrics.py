@@ -82,6 +82,36 @@ def test_device_batch_metrics_match_host():
         assert float(out["ndcg10"][i]) == pytest.approx(n10, rel=1e-4)
 
 
+def test_full_pool_metrics_match_host():
+    """Variable-pool device metrics == host compute_amn per impression."""
+    from fedrec_tpu.eval import full_pool_metrics_batch
+
+    rng = np.random.default_rng(11)
+    B, P = 16, 13
+    pos = rng.standard_normal(B)
+    neg = rng.standard_normal((B, P))
+    lens = rng.integers(1, P + 1, B)
+    mask = (np.arange(P)[None, :] < lens[:, None]).astype(np.float32)
+    out = full_pool_metrics_batch(pos, neg, mask)
+    for i in range(B):
+        y_true = np.array([1] + [0] * int(lens[i]))
+        scores = np.concatenate([[pos[i]], neg[i, : lens[i]]])
+        auc, mrr, n5, n10 = compute_amn(y_true, scores)
+        assert float(out["auc"][i]) == pytest.approx(auc, rel=1e-4)
+        assert float(out["mrr"][i]) == pytest.approx(mrr, rel=1e-4)
+        assert float(out["ndcg5"][i]) == pytest.approx(n5, rel=1e-4)
+        assert float(out["ndcg10"][i]) == pytest.approx(n10, rel=1e-4)
+
+
+def test_full_pool_metrics_empty_pool_flagged():
+    from fedrec_tpu.eval import full_pool_metrics_batch
+
+    out = full_pool_metrics_batch(
+        np.array([1.0]), np.array([[0.5, 0.7]]), np.array([[0.0, 0.0]])
+    )
+    assert float(out["auc"][0]) == 0.0  # caller masks these out
+
+
 def test_device_batch_metrics_rank_extremes():
     # positive scored highest -> all perfect; lowest -> floor values
     hi = np.array([[5.0, 1.0, 0.0, -1.0, -2.0]])

@@ -93,7 +93,7 @@ def test_trainer_finetune_round(tmp_path):
     crash: evaluate() read self.token_states, which is None in this mode)."""
     from fedrec_tpu.train.trainer import Trainer
 
-    cfg = finetune_cfg(tmp_path, fed__rounds=2)
+    cfg = finetune_cfg(tmp_path, fed__rounds=2, train__eval_protocol="sampled")
     data = finetune_data(cfg)
     trainer = Trainer(cfg, data, token_states=None)
     history = trainer.run()
@@ -101,6 +101,9 @@ def test_trainer_finetune_round(tmp_path):
     assert all(np.isfinite(h.train_loss) for h in history)
     m = history[-1].val_metrics
     assert m and np.isfinite(m["loss"]) and 0 <= m["auc"] <= 1
+    # the deterministic protocols share the finetune corpus-encode path
+    full = trainer.evaluate_full()
+    assert 0 <= full["auc"] <= 1
 
 
 def test_trainer_finetune_resume_bit_identical(tmp_path):
@@ -127,6 +130,56 @@ def test_trainer_finetune_resume_bit_identical(tmp_path):
     np.testing.assert_allclose(
         flat_news(t_a), flat_news(t_b2), rtol=1e-6, atol=1e-7
     )
+
+
+def test_trainer_evaluate_full_matches_bruteforce(tmp_path):
+    """evaluate_full == a per-impression host loop over the same table:
+    full-pool protocol (published-table parity) and the last-4 slice
+    (reference client.py:159-160)."""
+    import jax
+    from fedrec_tpu.eval import compute_amn
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=1)
+    cfg.model.text_encoder_mode = "head"
+    data, token_states = tiny_data(cfg)
+    trainer = Trainer(cfg, data, token_states)
+
+    for last_k in (None, 4):
+        got = trainer.evaluate_full(last_k=last_k)
+
+        user_params, news_params = trainer._client0_params()
+        table = np.asarray(trainer._encode_corpus(news_params))
+        ix = trainer.valid_ix
+        rows = []
+        for i in range(len(ix)):
+            lens = int(ix.neg_lens[i])
+            negs = ix.neg_pools[i, :lens]
+            if last_k is not None:
+                negs = negs[-last_k:]
+            if len(negs) == 0:
+                continue
+            his = ix.history[i][None]
+            user_vec = np.asarray(
+                trainer.model.apply(
+                    {"params": {"user_encoder": user_params}},
+                    jax.numpy.asarray(table[his]),
+                    method=NewsRecommender.encode_user,
+                )
+            )[0]
+            scores = np.concatenate(
+                [[table[ix.pos[i]] @ user_vec], table[negs] @ user_vec]
+            )
+            y_true = np.array([1] + [0] * len(negs))
+            rows.append(compute_amn(y_true, scores))
+        want = np.mean(np.array(rows), axis=0)
+        for j, k in enumerate(("auc", "mrr", "ndcg5", "ndcg10")):
+            assert got[k] == pytest.approx(want[j], rel=1e-3), (last_k, k)
+
+    # determinism: a second call gives bit-identical results
+    again = trainer.evaluate_full()
+    assert again == trainer.evaluate_full()
 
 
 def test_trainer_native_loader_round(tmp_path):
@@ -206,9 +259,10 @@ WORKER = textwrap.dedent(
     agg2 = aggregate_from_hosts(local, weight=1.0 if pid == 0 else 0.0)
     np.testing.assert_allclose(np.asarray(agg2["w"]), 1.0)
 
-    # round flags
-    assert rt.start_round(0, 2) is True
-    assert rt.start_round(2, 2) is False
+    # round negotiation: server's counter wins; -1 = stop
+    assert rt.start_round(0, 2) == 0
+    assert rt.start_round(1, 2) == 1
+    assert rt.start_round(2, 2) == -1
     print("WORKER_OK", pid)
     """
 )
@@ -250,6 +304,72 @@ def test_coordinator_two_process_cpu(tmp_path):
         assert f"WORKER_OK {pid}" in out
 
 
+FAULT_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import jax
+    from fedrec_tpu.parallel.multihost import CoordinatorRuntime, initialize_distributed
+
+    port, pid, rounds = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    rt = CoordinatorRuntime(collective_timeout_s=10.0)
+    params = {"w": np.full((4,), 1.0 + pid, np.float32)}
+
+    r = 0
+    while True:
+        nxt = rt.start_round(r, rounds)
+        if nxt < 0:
+            break
+        r = nxt
+        params = rt.sync_from_server(params)
+        if pid == 1 and r == 1:
+            print("WORKER_DYING", flush=True)
+            os._exit(1)  # simulate an unplanned crash mid-round
+        params = rt.aggregate(params)
+        print(f"ROUND_DONE {pid} {r} degraded={rt.degraded}", flush=True)
+        r += 1
+    print(f"WORKER_DONE {pid} rounds={r} degraded={rt.degraded}", flush=True)
+    rt.finalize(0)  # degraded world: skip the broken shutdown barrier
+    """
+)
+
+
+def test_coordinator_survives_peer_death(tmp_path):
+    """A dead peer must not hang the survivor: the watchdog degrades it to
+    standalone training and it completes ALL rounds (the reference hangs
+    until a 2-day gloo timeout, client.py:227 / Final_Report VII.a)."""
+    port = _free_port()
+    script = tmp_path / "fault_worker.py"
+    script.write_text(FAULT_WORKER)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    rounds = 4
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid), str(rounds)],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    try:
+        out1, _ = procs[1].communicate(timeout=180)
+        assert "WORKER_DYING" in out1 and procs[1].returncode == 1
+        out0, _ = procs[0].communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("survivor hung after peer death")
+    assert procs[0].returncode == 0, f"survivor failed:\n{out0[-3000:]}"
+    assert f"WORKER_DONE 0 rounds={rounds} degraded=True" in out0
+
+
 COORD_CLI = textwrap.dedent(
     """
     import os, sys
@@ -257,8 +377,9 @@ COORD_CLI = textwrap.dedent(
     os.environ["JAX_PLATFORMS"] = "cpu"
     from fedrec_tpu.cli.coordinator import main
     port, pid, snap = sys.argv[1], sys.argv[2], sys.argv[3]
+    rounds = sys.argv[4] if len(sys.argv) > 4 else "2"
     code = main([
-        "2", "8", "1",
+        rounds, "8", "1",
         "--coordinator", f"127.0.0.1:{port}",
         "--num-processes", "2", "--process-id", pid,
         "--synthetic", "--clients", "1",
@@ -270,6 +391,54 @@ COORD_CLI = textwrap.dedent(
     sys.exit(code)
     """
 )
+
+
+def _run_coord_cli(tmp_path, script, rounds, dirs, tag):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid), str(dirs[pid]), str(rounds)],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"coordinator CLI ({tag}) timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{tag} process {pid} failed:\n{out[-3000:]}"
+    return outs
+
+
+def test_coordinator_cli_resume_bit_identical(tmp_path):
+    """Multi-process resume restores full client state (opt + PRNG): a
+    1-round run resumed for round 2 produces the same global model as an
+    uninterrupted 2-round run."""
+    script = tmp_path / "coord_cli.py"
+    script.write_text(COORD_CLI)
+
+    a_dirs = [tmp_path / "a0", tmp_path / "a1"]
+    _run_coord_cli(tmp_path, script, 2, a_dirs, "straight")
+
+    b_dirs = [tmp_path / "b0", tmp_path / "b1"]
+    _run_coord_cli(tmp_path, script, 1, b_dirs, "first-leg")
+    outs = _run_coord_cli(tmp_path, script, 2, b_dirs, "resumed")
+    assert any("resumed local state at round 0" in o for o in outs)
+
+    a = (a_dirs[0] / "global_round_1.msgpack").read_bytes()
+    b = (b_dirs[0] / "global_round_1.msgpack").read_bytes()
+    assert a == b
 
 
 def test_coordinator_cli_two_process(tmp_path):
