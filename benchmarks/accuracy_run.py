@@ -1,0 +1,320 @@
+"""Accuracy-loop experiment: train to convergence, report a learning curve.
+
+VERDICT round-1 item 4: nothing in the repo had ever trained toward a real
+ranking number. Real MIND needs the raw tsv download (zero egress here —
+the preprocessing pipeline for it exists in ``fedrec_tpu/data/preprocess.py``),
+so this trains on the largest corpus obtainable offline: the topic-structured
+synthetic generator (``make_synthetic_mind_topics``) whose Bayes-optimal
+full-pool AUC is known by construction (~0.90 at defaults) and empirically
+bounded by an oracle scorer. Metrics use the deterministic full-pool protocol
+(the one behind the reference's published table, reference
+``evaluation_functions.py:33-47``; published numbers ``README.md:70-80``).
+
+Legs (each a subprocess with its own platform env, like ``bench.py``):
+
+  * ``central``  — flagship single-chip run at reference scale (768-d trunk
+    states, 50-token titles, 50k impressions) on the TPU if live, else CPU.
+  * ``fed``      — 8-client federation on a fake CPU mesh (small corpus):
+    local vs param_avg vs grad_avg vs param_avg+DP(eps=10) — shows
+    federation/DP cost on accuracy.
+  * ``report``   — collect ``benchmarks/accuracy_*.json`` into RESULTS.md.
+
+Usage:  python benchmarks/accuracy_run.py --all
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+HERE = Path(__file__).resolve().parent
+REPO = HERE.parent
+if str(REPO) not in sys.path:  # runnable as `python benchmarks/accuracy_run.py`
+    sys.path.insert(0, str(REPO))
+
+
+# --------------------------------------------------------------------- data
+def _central_corpus():
+    from fedrec_tpu.data import make_synthetic_mind_topics
+
+    if os.environ.get("FEDREC_ACC_SMOKE"):  # fast correctness pass of the glue
+        return make_synthetic_mind_topics(
+            num_news=256, num_train=400, num_valid=100, title_len=8,
+            bert_hidden=768, his_len_range=(3, 10), seed=7,
+        )
+    return make_synthetic_mind_topics(
+        num_news=4096,
+        num_train=50_000,
+        num_valid=5_000,
+        title_len=50,
+        bert_hidden=768,
+        seed=7,
+    )
+
+
+def _small_corpus():
+    from fedrec_tpu.data import make_synthetic_mind_topics
+
+    return make_synthetic_mind_topics(
+        num_news=1024,
+        num_train=8_000,
+        num_valid=2_000,
+        title_len=12,
+        bert_hidden=96,
+        his_len_range=(5, 20),
+        seed=11,
+    )
+
+
+def oracle_auc(data, states) -> float:
+    """Full-pool AUC of the cheating scorer: cosine(candidate centroid,
+    mean history centroid) on the raw trunk states — an empirical ceiling
+    for what the two-tower model can recover."""
+    cent = np.asarray(states, np.float32).mean(axis=1)
+    cent /= np.linalg.norm(cent, axis=1, keepdims=True) + 1e-9
+    n2i = data.nid2index
+    aucs = []
+    for _, pos, negs, his, _ in data.valid_samples:
+        hv = cent[[n2i[h] for h in his]].mean(0)
+        s_pos = float(hv @ cent[n2i[pos]])
+        s_neg = cent[[n2i[x] for x in negs]] @ hv
+        aucs.append(
+            (np.sum(s_pos > s_neg) + 0.5 * np.sum(s_pos == s_neg)) / len(s_neg)
+        )
+    return float(np.mean(aucs))
+
+
+# --------------------------------------------------------------------- legs
+def _train(cfg, data, states):
+    from fedrec_tpu.train.trainer import Trainer
+
+    t0 = time.time()
+    trainer = Trainer(cfg, data, states, snapshot_dir=None)
+    history = trainer.run()
+    return {
+        "wall_s": round(time.time() - t0, 1),
+        "curve": [
+            {
+                "round": r.round_idx,
+                "train_loss": round(r.train_loss, 5),
+                **{k: round(v, 5) for k, v in r.val_metrics.items()},
+            }
+            for r in history
+        ],
+    }
+
+
+def leg_central(rounds: int) -> None:
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+
+    platform = jax.devices()[0].platform
+    data, states = _central_corpus()
+
+    cfg = ExperimentConfig()
+    cfg.model.text_encoder_mode = "head"
+    if platform != "cpu":
+        cfg.model.dtype = "bfloat16"
+    cfg.fed.strategy = "local"
+    cfg.fed.num_clients = 1
+    cfg.fed.rounds = rounds
+    cfg.train.eval_protocol = "full"
+    cfg.train.eval_every = 1
+    cfg.train.snapshot_dir = ""
+    cfg.train.resume = False
+
+    out = {
+        "leg": "central",
+        "platform": platform,
+        "device": getattr(jax.devices()[0], "device_kind", platform),
+        "corpus": {
+            "num_news": data.num_news,
+            "train": len(data.train_samples),
+            "valid": len(data.valid_samples),
+            "bert_hidden": 768,
+        },
+        "oracle_auc": round(oracle_auc(data, states), 4),
+        "config": {"mode": "head", "dtype": cfg.model.dtype,
+                   "lr": cfg.optim.user_lr, "batch": cfg.data.batch_size},
+        **_train(cfg, data, states),
+    }
+    (HERE / "accuracy_central.json").write_text(json.dumps(out, indent=2))
+    print(json.dumps({k: out[k] for k in ("leg", "platform", "oracle_auc", "wall_s")}))
+
+
+def leg_fed(rounds: int) -> None:
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+
+    data, states = _small_corpus()
+    runs = {}
+    for name, (strategy, clients, dp) in {
+        "local_1client": ("local", 1, False),
+        "param_avg_8": ("param_avg", 8, False),
+        "grad_avg_8": ("grad_avg", 8, False),
+        "param_avg_8_dp10": ("param_avg", 8, True),
+    }.items():
+        cfg = ExperimentConfig()
+        cfg.model.text_encoder_mode = "head"
+        cfg.model.news_dim = 64
+        cfg.model.num_heads = 8
+        cfg.model.head_dim = 8
+        cfg.model.query_dim = 32
+        cfg.model.bert_hidden = 96
+        cfg.data.max_title_len = 12
+        cfg.data.max_his_len = 20
+        cfg.fed.strategy = strategy
+        cfg.fed.num_clients = clients
+        cfg.fed.rounds = rounds
+        cfg.train.eval_protocol = "full"
+        cfg.train.eval_every = 1
+        cfg.train.snapshot_dir = ""
+        cfg.train.resume = False
+        if dp:
+            cfg.privacy.enabled = True
+            cfg.privacy.epsilon = 10.0
+        runs[name] = _train(cfg, data, states)
+        print(f"[fed] {name}: final "
+              f"{runs[name]['curve'][-1] if runs[name]['curve'] else '?'}")
+
+    out = {
+        "leg": "fed",
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "corpus": {
+            "num_news": data.num_news,
+            "train": len(data.train_samples),
+            "valid": len(data.valid_samples),
+            "bert_hidden": 96,
+        },
+        "oracle_auc": round(oracle_auc(data, states), 4),
+        "runs": runs,
+    }
+    (HERE / "accuracy_fed.json").write_text(json.dumps(out, indent=2))
+
+
+# ------------------------------------------------------------------- report
+def write_report() -> None:
+    central = json.loads((HERE / "accuracy_central.json").read_text())
+    fed = json.loads((HERE / "accuracy_fed.json").read_text())
+
+    lines = [
+        "# RESULTS — end-to-end accuracy loop",
+        "",
+        "Deterministic **full-negative-pool** evaluation (the protocol behind",
+        "the reference's published MIND table, reference",
+        "`evaluation_functions.py:33-47`): AUC / MRR / NDCG@5 / NDCG@10 averaged",
+        "over every validation impression's entire pool. Data is the",
+        "topic-structured synthetic corpus (`make_synthetic_mind_topics`) — the",
+        "largest corpus obtainable offline (real MIND needs the tsv download;",
+        "the preprocessing for it is `fedrec_tpu/data/preprocess.py`). The",
+        "corpus has a *known* recoverable signal: an oracle scorer on the raw",
+        "trunk states bounds what any model can reach.",
+        "",
+        "## 1. Flagship centralized run",
+        "",
+        f"Platform **{central['platform']}** ({central['device']}), mode",
+        f"`head` (trainable text head over cached trunk states), dtype",
+        f"`{central['config']['dtype']}`, lr {central['config']['lr']},",
+        f"batch {central['config']['batch']}. Corpus: {central['corpus']['train']:,}",
+        f"train / {central['corpus']['valid']:,} valid impressions over",
+        f"{central['corpus']['num_news']:,} news, 768-d trunk states.",
+        f"Oracle (ceiling) AUC: **{central['oracle_auc']:.4f}**.",
+        f"Wall-clock: {central['wall_s']}s.",
+        "",
+        "| round | train loss | AUC | MRR | NDCG@5 | NDCG@10 |",
+        "|---|---|---|---|---|---|",
+    ]
+    for row in central["curve"]:
+        lines.append(
+            f"| {row['round']} | {row['train_loss']:.4f} | {row.get('auc', float('nan')):.4f} "
+            f"| {row.get('mrr', float('nan')):.4f} | {row.get('ndcg5', float('nan')):.4f} "
+            f"| {row.get('ndcg10', float('nan')):.4f} |"
+        )
+    last = central["curve"][-1]
+    frac = last.get("auc", 0.0) / max(central["oracle_auc"], 1e-9)
+    lines += [
+        "",
+        f"Final AUC {last.get('auc', float('nan')):.4f} = "
+        f"**{100 * frac:.1f}% of the oracle ceiling** "
+        f"(random = 0.5).",
+        "",
+        "## 2. Federation and privacy cost (8-client CPU mesh)",
+        "",
+        f"Same protocol on a small corpus ({fed['corpus']['train']:,} train /",
+        f"{fed['corpus']['valid']:,} valid, {fed['corpus']['num_news']:,} news,",
+        f"96-d states), {fed['n_devices']}-device fake mesh. Oracle AUC:",
+        f"**{fed['oracle_auc']:.4f}**.",
+        "",
+        "| run | final AUC | final MRR | final NDCG@10 | wall s |",
+        "|---|---|---|---|---|",
+    ]
+    for name, run in fed["runs"].items():
+        c = run["curve"][-1]
+        lines.append(
+            f"| {name} | {c.get('auc', float('nan')):.4f} | {c.get('mrr', float('nan')):.4f} "
+            f"| {c.get('ndcg10', float('nan')):.4f} | {run['wall_s']} |"
+        )
+    lines += [
+        "",
+        "Full per-round curves: `benchmarks/accuracy_central.json`,",
+        "`benchmarks/accuracy_fed.json`. Reproduce:",
+        "`python benchmarks/accuracy_run.py --all`.",
+        "",
+    ]
+    (REPO / "RESULTS.md").write_text("\n".join(lines))
+    print(f"wrote {REPO / 'RESULTS.md'}")
+
+
+# --------------------------------------------------------------------- main
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--leg", choices=["central", "fed", "report"])
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--rounds", type=int, default=16)
+    p.add_argument("--fed-rounds", type=int, default=10)
+    args = p.parse_args()
+
+    if args.all:
+        env_fed = dict(os.environ)
+        env_fed.pop("PALLAS_AXON_POOL_IPS", None)
+        env_fed["JAX_PLATFORMS"] = "cpu"
+        env_fed["XLA_FLAGS"] = (
+            env_fed.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        me = str(HERE / "accuracy_run.py")
+        for cmd, env in (
+            ([sys.executable, me, "--leg", "central", "--rounds", str(args.rounds)],
+             dict(os.environ)),
+            ([sys.executable, me, "--leg", "fed", "--rounds", str(args.fed_rounds)],
+             env_fed),
+            ([sys.executable, me, "--leg", "report"], dict(os.environ)),
+        ):
+            rc = subprocess.run(cmd, env=env, cwd=REPO).returncode
+            if rc != 0:
+                return rc
+        return 0
+
+    if args.leg == "central":
+        leg_central(args.rounds)
+    elif args.leg == "fed":
+        leg_fed(args.rounds)
+    elif args.leg == "report":
+        write_report()
+    else:
+        p.error("pass --leg or --all")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,6 +65,14 @@ class ModelConfig:
     sigmoid_before_ce: bool = True
     dtype: str = "float32"             # compute dtype for encoders ("bfloat16" on TPU)
     use_pallas: bool = False           # route hot ops through Pallas kernels
+    # user-encoder self-attention implementation:
+    #   "auto"    — dense XLA up to attn_chunk_threshold history items, then
+    #               blockwise lax.scan (O(L) memory); pallas if use_pallas
+    #   "dense" | "chunked" | "pallas" — force one path
+    # benchmarks/pallas_bench.json is the evidence behind the default: dense
+    # XLA wins at every size that fits, chunked is the long-context fallback.
+    attn_impl: str = "auto"
+    attn_chunk_threshold: int = 1024
 
 
 @dataclass

@@ -1,4 +1,9 @@
-from fedrec_tpu.data.mind import MindData, load_mind_artifacts, make_synthetic_mind
+from fedrec_tpu.data.mind import (
+    MindData,
+    load_mind_artifacts,
+    make_synthetic_mind,
+    make_synthetic_mind_topics,
+)
 from fedrec_tpu.data.sampling import newsample
 from fedrec_tpu.data.batcher import (
     Batch,
@@ -39,6 +44,7 @@ __all__ = [
     "index_samples",
     "load_mind_artifacts",
     "make_synthetic_mind",
+    "make_synthetic_mind_topics",
     "newsample",
     "parse_adressa_events",
     "parse_behaviors_tsv",

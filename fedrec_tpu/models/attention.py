@@ -105,6 +105,9 @@ class MultiHeadAttention(nn.Module):
     use_pallas: bool = False
     seq_axis: str | None = None  # sequence-parallel mesh axis (inside shard_map)
     seq_impl: str = "ring"  # "ring" | "ulysses"
+    # "auto" | "dense" | "chunked" | "pallas" — see ModelConfig.attn_impl
+    attn_impl: str = "auto"
+    chunk_threshold: int = 1024
 
     @nn.compact
     def __call__(
@@ -142,12 +145,31 @@ class MultiHeadAttention(nn.Module):
             context = sp(q_s, k_s, v_s, mask, self.seq_axis)
             return context.reshape(*batch, L, d)
 
-        if self.use_pallas and self.stable_softmax:
+        impl = self.attn_impl
+        if impl == "auto":
+            if self.use_pallas and self.stable_softmax:
+                impl = "pallas"
+            elif L > self.chunk_threshold and self.stable_softmax:
+                impl = "chunked"
+            else:
+                impl = "dense"
+        if impl == "pallas":
             # blocked online-softmax kernel: no (..., H, L, L) score tensor
             from fedrec_tpu.ops import flash_attention
 
             context = flash_attention(q_s, k_s, v_s, mask)
             return context.reshape(*batch, L, d)
+        if impl == "chunked":
+            # blockwise lax.scan, O(L) memory — the single-chip long-context
+            # path (chunked_attention docstring has the measured rationale)
+            from fedrec_tpu.ops import chunked_attention
+
+            context = chunked_attention(q_s, k_s, v_s, mask)
+            return context.reshape(*batch, L, d)
+        if impl != "dense":
+            raise ValueError(
+                f"attn_impl must be auto|dense|chunked|pallas, got {impl!r}"
+            )
 
         scores = jnp.einsum("...qhd,...khd->...hqk", q_s, k_s) / jnp.sqrt(
             jnp.asarray(self.head_dim, dtype=q_s.dtype)

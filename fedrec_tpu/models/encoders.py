@@ -61,6 +61,8 @@ class UserEncoder(nn.Module):
     use_pallas: bool = False
     seq_axis: str | None = None  # shard history over this mesh axis (long context)
     seq_impl: str = "ring"
+    attn_impl: str = "auto"      # see ModelConfig.attn_impl
+    chunk_threshold: int = 1024
 
     @nn.compact
     def __call__(
@@ -78,6 +80,8 @@ class UserEncoder(nn.Module):
             use_pallas=self.use_pallas,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
+            attn_impl=self.attn_impl,
+            chunk_threshold=self.chunk_threshold,
             name="self_attn",
         )(x, x, x, mask)
         return AdditiveAttention(

@@ -85,6 +85,8 @@ class NewsRecommender(nn.Module):
             use_pallas=self.cfg.use_pallas,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
+            attn_impl=self.cfg.attn_impl,
+            chunk_threshold=self.cfg.attn_chunk_threshold,
         )
 
     def encode_news(

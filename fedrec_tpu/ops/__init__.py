@@ -16,5 +16,6 @@ routed from the Flax modules via ``ModelConfig.use_pallas``.
 """
 
 from fedrec_tpu.ops.attention_kernels import additive_pool, flash_attention
+from fedrec_tpu.ops.chunked_attention import chunked_attention
 
-__all__ = ["additive_pool", "flash_attention"]
+__all__ = ["additive_pool", "chunked_attention", "flash_attention"]

@@ -199,7 +199,9 @@ def _pool_kernel(x_ref, w1_ref, b1_ref, w2_ref, bias_ref, o_ref):
     """One row-block program: fused tanh-MLP scores + softmax + weighted sum.
 
     x_ref: (block_n, L, D)  w1: (D, Hd)  b1: (1, Hd)  w2: (Hd, 1)
-    bias_ref: (block_n, L) additive key bias.
+    bias_ref: (block_n, 1, L) additive key bias; o_ref: (block_n, 1, D).
+    (bias/out carry a middle singleton so their constrained last-two block
+    dims equal the array dims for any block_n — the sublane rule.)
     """
     bn, L, D = x_ref.shape
     x = x_ref[:].astype(jnp.float32)
@@ -215,17 +217,25 @@ def _pool_kernel(x_ref, w1_ref, b1_ref, w2_ref, bias_ref, o_ref):
     logits = jax.lax.dot_general(
         e, w2_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )[:, :1].reshape(bn, L) + bias_ref[:]
+    )[:, :1].reshape(bn, L) + bias_ref[:, 0, :]
     alpha = jax.nn.softmax(logits, axis=-1)
     pooled = jax.lax.dot_general(
         alpha[:, None, :], x, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )[:, 0, :]
-    o_ref[:] = pooled.astype(o_ref.dtype)
+    o_ref[:, 0, :] = pooled.astype(o_ref.dtype)
 
 
 def _pool_forward(x, w1, b1, w2, bias, block_n):
     n, L, D = x.shape
+    # the kernel holds x (block_n, L_pad, d_pad) plus the tanh activations
+    # (block_n*L_pad, h_pad) in f32 VMEM; shrink block_n so long sequences
+    # stay under the ~16 MB scoped-vmem limit (H=1024 at the default 8 OOMs)
+    l_pad = L + (-L) % _SUBLANE
+    d_pad = D + (-D) % _LANE
+    h_pad = w1.shape[1] + (-w1.shape[1]) % _LANE
+    per_row_bytes = l_pad * (d_pad + h_pad) * 4
+    block_n = max(1, min(block_n, (6 << 20) // per_row_bytes))
     xp = _pad_to(_pad_to(_pad_to(x, 0, block_n), 1, _SUBLANE), 2, _LANE)
     biasp = _pad_to(_pad_to(bias, 0, block_n), 1, _SUBLANE)
     if biasp.shape[1] > L:  # padded sequence slots must never win the softmax
@@ -237,19 +247,19 @@ def _pool_forward(x, w1, b1, w2, bias, block_n):
 
     out = pl.pallas_call(
         _pool_kernel,
-        out_shape=jax.ShapeDtypeStruct((n_pad, d_pad), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1, d_pad), x.dtype),
         grid=(n_pad // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, xp.shape[1], d_pad), lambda i: (i, 0, 0)),
             pl.BlockSpec((d_pad, h_pad), lambda i: (0, 0)),
             pl.BlockSpec((1, h_pad), lambda i: (0, 0)),
             pl.BlockSpec((h_pad, w2p.shape[1]), lambda i: (0, 0)),
-            pl.BlockSpec((block_n, xp.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1, xp.shape[1]), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((block_n, d_pad), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_n, 1, d_pad), lambda i: (i, 0, 0)),
         interpret=_interpret(),
-    )(xp, w1p, b1p, w2p, biasp)
-    return out[:n, :D]
+    )(xp, w1p, b1p, w2p, biasp[:, None, :])
+    return out[:n, 0, :D]
 
 
 def _pool_dense(x, w1, b1, w2, bias):
