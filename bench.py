@@ -46,7 +46,7 @@ _PEAK_FLOPS = {
 }
 
 
-def _probe_accelerator(attempts: int = 3, timeout_s: int = 180) -> bool:
+def _probe_accelerator(attempts: int = 3, timeout_s: int = 120) -> bool:
     """True when ``jax.devices()`` initializes a non-CPU backend in time.
 
     Runs in a subprocess (a wedged tunnel hangs the whole process, not just
@@ -183,7 +183,7 @@ def main() -> None:
             },
         )
 
-    def measure(bsz: int, iters: int, warmup: int = 3):
+    def measure(bsz: int, iters: int, warmup: int = 3, the_step=None, feats=None):
         """Overhead-corrected sec/step.
 
         Two honesty rules learned on the axon tunnel (verified against a
@@ -193,6 +193,8 @@ def main() -> None:
         per-step time is taken from the DIFFERENCE of a 2x-length and a
         1x-length chain, cancelling the constant.
         """
+        the_step = the_step or step
+        feats = token_states if feats is None else feats
         state0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, L)
         stacked = replicate_state(state0, 1, jax.random.PRNGKey(1))
         batches = [make_batch(s, bsz) for s in range(8)]
@@ -202,7 +204,7 @@ def main() -> None:
             t0 = time.perf_counter()
             metrics = None
             for i in range(k):
-                stacked, metrics = step(stacked, batches[i % 8], token_states)
+                stacked, metrics = the_step(stacked, batches[i % 8], feats)
             np.asarray(metrics["loss"])  # readback = real synchronization
             return time.perf_counter() - t0
 
@@ -223,7 +225,9 @@ def main() -> None:
             f"(last t1={t1:.4f}, t2={t2:.4f}, iters={iters}); rerun"
         )
 
-    dt = measure(B, iters=50 if on_tpu else 20)
+    # CPU fallback: ~4 s/step, so short chains already dwarf timer noise —
+    # long ones would blow the driver's wall-clock budget
+    dt = measure(B, iters=50 if on_tpu else 5)
     samples_per_sec = B / dt
 
     out = {
@@ -244,6 +248,13 @@ def main() -> None:
         base = json.loads(baseline_path.read_text())
         out["vs_baseline"] = round(samples_per_sec / base["samples_per_sec"], 2)
 
+    cache_path = Path(__file__).parent / "benchmarks" / "last_tpu_bench.json"
+    if not on_tpu and cache_path.exists():
+        # the tunnel to the chip wedges transiently; a CPU fallback must not
+        # erase recorded TPU evidence — attach the last real-chip result,
+        # clearly labeled as cached
+        out["last_tpu_result_cached"] = json.loads(cache_path.read_text())
+
     if on_tpu:
         flops = _flops_per_train_step(cfg, B, num_news)
         kind = getattr(device, "device_kind", "").lower()
@@ -257,6 +268,26 @@ def main() -> None:
         B8 = 8 * B
         dt8 = measure(B8, iters=20)
         out["clients8_samples_per_sec"] = round(B8 / dt8, 2)
+
+        cache_path.write_text(json.dumps(out, indent=2))  # primary evidence
+
+        # decoupled (reference-parity) mode: the text tower leaves the step —
+        # news vecs come from a precomputed (N, D) table gather; this is the
+        # per-batch cost the reference's epoch structure actually implies.
+        # A bonus metric: its failure must not discard the primary numbers.
+        try:
+            from fedrec_tpu.train import encode_all_news
+
+            p0 = init_client_state(model, cfg, jax.random.PRNGKey(0), num_news, L)
+            table = encode_all_news(model, p0.news_params, token_states)
+            step_d = build_fed_train_step(
+                model, cfg, get_strategy("grad_avg"), mesh, mode="decoupled"
+            )
+            dt_d = measure(B, iters=100, the_step=step_d, feats=table)
+            out["decoupled_samples_per_sec"] = round(B / dt_d, 2)
+            cache_path.write_text(json.dumps(out, indent=2))
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] decoupled bonus metric failed: {e}\n")
 
     print(json.dumps(out))
 

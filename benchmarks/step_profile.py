@@ -1,0 +1,138 @@
+"""Decompose the flagship joint train step's time on the real chip.
+
+Times each component of the B=64 joint step with the tunnel-honest chain
+timer (``pallas_bench._time``): token-state gather, unique-ids dedup, text
+tower fwd / fwd+bwd, user tower fwd / fwd+bwd, loss+optimizer, and the full
+step — so perf work aims at the measured bottleneck instead of the analytic
+FLOPs model (which says text-tower matmuls dominate; MFU 0.20 says ~2.5x is
+being lost somewhere).
+
+Run on TPU:  python benchmarks/step_profile.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pallas_bench import _time  # noqa: E402  (same honest timer)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender, score_loss
+    from fedrec_tpu.train.step import _batch_news_vecs
+
+    if jax.devices()[0].platform == "cpu":
+        print("needs the TPU (honest timing assumptions)", file=sys.stderr)
+
+    cfg = ExperimentConfig()
+    cfg.model.dtype = "bfloat16"
+    num_news, L = 4096, cfg.data.max_title_len
+    B, C, H = 64, 1 + cfg.data.npratio, cfg.data.max_his_len
+    Dh = cfg.model.bert_hidden
+
+    rng = np.random.default_rng(0)
+    token_states = jnp.asarray(
+        rng.standard_normal((num_news, L, Dh), dtype=np.float32),
+        jnp.dtype(cfg.model.dtype),
+    )
+    candidates = jnp.asarray(rng.integers(0, num_news, (B, C)).astype(np.int32))
+    history = jnp.asarray(rng.integers(0, num_news, (B, H)).astype(np.int32))
+    labels = jnp.zeros((B,), jnp.int32)
+
+    model = NewsRecommender(cfg.model)
+    dummy_states = token_states[:1]
+    dummy_cand = jnp.zeros((1, C, cfg.model.news_dim), jnp.dtype(cfg.model.dtype))
+    dummy_his = jnp.zeros((1, H, cfg.model.news_dim), jnp.dtype(cfg.model.dtype))
+    variables = model.init(
+        jax.random.PRNGKey(0), dummy_states, dummy_cand, dummy_his,
+        method=NewsRecommender.init_both_towers,
+    )
+    text_p = variables["params"]["text_head"]
+    user_p = variables["params"]["user_encoder"]
+
+    size = B * (C + H)
+    flat_ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
+
+    # ---- components (first arg is the one _time perturbs/chains on)
+    def gather_only(ts):
+        uniq, inv = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
+                               return_inverse=True)
+        return ts[uniq].sum()
+
+    def unique_only(ids_f32):
+        # ids passed as float so the chain perturbation type-checks; cast back
+        uniq, inv = jnp.unique(ids_f32.astype(jnp.int32), size=min(size, num_news),
+                               fill_value=0, return_inverse=True)
+        return uniq.sum() + inv.sum()
+
+    def text_fwd(ts):
+        uniq, _ = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
+                             return_inverse=True)
+        return model.apply({"params": {"text_head": text_p}}, ts[uniq],
+                           method=NewsRecommender.encode_news).sum()
+
+    def text_fwd_bwd(ts):
+        def loss(p):
+            uniq, _ = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
+                                 return_inverse=True)
+            return model.apply({"params": {"text_head": p}}, ts[uniq],
+                               method=NewsRecommender.encode_news).sum()
+        return jax.tree_util.tree_leaves(jax.grad(loss)(text_p))[0].sum()
+
+    cand_vecs, his_vecs = _batch_news_vecs(
+        model, text_p, token_states, candidates, history
+    )
+
+    def user_fwd(cv):
+        scores = model.apply({"params": {"user_encoder": user_p}}, cv, his_vecs)
+        return scores.sum()
+
+    def user_fwd_bwd(cv):
+        def loss(p):
+            scores = model.apply({"params": {"user_encoder": p}}, cv, his_vecs)
+            return score_loss(scores, labels)
+        return jax.tree_util.tree_leaves(jax.grad(loss)(user_p))[0].sum()
+
+    def full_fwd_bwd(ts):
+        def loss(ps):
+            cv, hv = _batch_news_vecs(model, ps["text"], ts, candidates, history)
+            scores = model.apply({"params": {"user_encoder": ps["user"]}}, cv, hv)
+            return score_loss(scores, labels)
+        g = jax.grad(loss)({"text": text_p, "user": user_p})
+        return jax.tree_util.tree_leaves(g)[0].sum()
+
+    comps = {
+        "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
+        "gather_only": (gather_only, token_states),
+        "text_fwd": (text_fwd, token_states),
+        "text_fwd_bwd": (text_fwd_bwd, token_states),
+        "user_fwd": (user_fwd, cand_vecs),
+        "user_fwd_bwd": (user_fwd_bwd, cand_vecs),
+        "full_fwd_bwd": (full_fwd_bwd, token_states),
+    }
+    out = {}
+    for name, (fn, arg0) in comps.items():
+        t = _time(jax.jit(fn), arg0)
+        out[name] = round(t * 1e3, 4)
+        print(f"{name:16s} {t*1e3:8.3f} ms", flush=True)
+
+    Path(__file__).with_name("step_profile.json").write_text(
+        json.dumps({"B": B, "components_ms": out}, indent=2)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,7 +58,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from fedrec_tpu.config import ExperimentConfig
     from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
-    from fedrec_tpu.privacy import calibrate_sigma
+    from fedrec_tpu.privacy import calibrate_from_config
     from fedrec_tpu.train.trainer import Trainer
 
     rt = CoordinatorRuntime(collective_timeout_s=args.collective_timeout or None)
@@ -93,13 +93,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.dp_epsilon > 0:
         cfg.privacy.enabled = True
         cfg.privacy.epsilon = args.dp_epsilon
-        n_train = max(len(data.train_samples), 1)
-        q = min(1.0, cfg.data.batch_size / max(n_train // cfg.fed.num_clients, 1))
-        steps = max(n_train // (cfg.fed.num_clients * cfg.data.batch_size), 1)
-        cfg.privacy.sigma = calibrate_sigma(
-            cfg.privacy.epsilon, cfg.privacy.delta, q,
-            steps * cfg.privacy.accountant_epochs,
-        )
+        cfg.privacy.sigma = calibrate_from_config(cfg, len(data.train_samples))
 
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
     local_snap = None

@@ -63,7 +63,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from fedrec_tpu.config import ExperimentConfig
     from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
-    from fedrec_tpu.privacy import calibrate_sigma
+    from fedrec_tpu.privacy import calibrate_from_config
     from fedrec_tpu.train.trainer import Trainer
 
     cfg = ExperimentConfig()
@@ -112,17 +112,7 @@ def main(argv: list[str] | None = None) -> int:
                 "no rigorous epsilon); use --mode joint for real DP-SGD",
                 file=sys.stderr,
             )
-        n_train = max(len(data.train_samples), 1)
-        steps_per_epoch = max(
-            n_train // (cfg.fed.num_clients * cfg.data.batch_size), 1
-        )
-        q = min(1.0, cfg.data.batch_size / max(n_train // cfg.fed.num_clients, 1))
-        cfg.privacy.sigma = calibrate_sigma(
-            cfg.privacy.epsilon,
-            cfg.privacy.delta,
-            q,
-            steps_per_epoch * cfg.privacy.accountant_epochs,
-        )
+        cfg.privacy.sigma = calibrate_from_config(cfg, len(data.train_samples))
         print(
             f"[run] DP enabled: eps={cfg.privacy.epsilon} delta={cfg.privacy.delta} "
             f"sigma={cfg.privacy.sigma:.4f} clip={cfg.privacy.clip_norm}",

@@ -44,6 +44,11 @@ def score_loss(
     returns the per-impression vector (used by evaluation to trim batch
     padding before averaging).
     """
+    # loss math always in f32 (cast BEFORE the sigmoid — a bf16 sigmoid
+    # would re-quantize): under a bfloat16 model the softmax/log lose ~3
+    # decimal digits, quantizing the loss metric (visibly: a constant
+    # 0.65625 across rounds) and coarsening gradients near convergence
+    scores = scores.astype(jnp.float32)
     logits = nn.sigmoid(scores) if sigmoid_before_ce else scores
     per_row = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     return jnp.mean(per_row) if reduce else per_row

@@ -1,4 +1,5 @@
 from fedrec_tpu.privacy.accountant import (
+    calibrate_from_config,
     calibrate_sigma,
     compute_epsilon,
     compute_rdp_subsampled_gaussian,
@@ -11,6 +12,7 @@ from fedrec_tpu.privacy.dpsgd import (
 )
 
 __all__ = [
+    "calibrate_from_config",
     "calibrate_sigma",
     "clip_by_global_norm_per_example",
     "compute_epsilon",

@@ -117,3 +117,22 @@ def calibrate_sigma(
         else:
             lo = mid
     return hi
+
+
+def calibrate_from_config(cfg, n_train: int) -> float:
+    """Sigma for ``cfg.privacy`` given the total training-sample count.
+
+    One shared definition of the sample rate ``q`` (per-client batch over
+    per-client data) and accountant step count — the CLI drivers and the
+    accuracy loop must agree or their privacy budgets silently diverge.
+    """
+    n_train = max(int(n_train), 1)
+    per_client = max(n_train // cfg.fed.num_clients, 1)
+    q = min(1.0, cfg.data.batch_size / per_client)
+    steps_per_epoch = max(per_client // cfg.data.batch_size, 1)
+    return calibrate_sigma(
+        cfg.privacy.epsilon,
+        cfg.privacy.delta,
+        q,
+        steps_per_epoch * cfg.privacy.accountant_epochs,
+    )

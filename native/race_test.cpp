@@ -1,0 +1,130 @@
+// ThreadSanitizer stress test for the fedrec_tpu native data engine.
+//
+// The reference has no race detection anywhere (SURVEY.md section 5.2: its
+// closest artifact is a hand-rolled thread join over a TCP accept loop,
+// reference server.py:92-98). This binary exercises every concurrent path of
+// the engine under TSAN:
+//   1. threaded whole-epoch fill (frd_fill_epoch worker pool),
+//   2. concurrent epoch-order cache rebuilds (frd_fill_batch from many
+//      threads with DIFFERENT epochs — stresses the perm-cache mutex and the
+//      shared_ptr readers that outlive a rebuild),
+//   3. determinism: the threaded fill must be byte-identical to the
+//      single-threaded fill regardless of schedule.
+//
+// Build + run: make -C native race_test   (wired into tests/test_native_batcher.py)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* frd_create(const int32_t*, const int32_t*, const int32_t*,
+                 const int32_t*, const int32_t*, int64_t, int64_t, int64_t,
+                 int64_t, int64_t, int, int, uint64_t);
+void frd_destroy(void*);
+int64_t frd_num_batches(void*, int64_t);
+int frd_fill_batch(void*, int64_t, int64_t, int64_t, int32_t*, int32_t*,
+                   int32_t*, int32_t*);
+int frd_fill_epoch(void*, int64_t, int64_t, int64_t, int32_t*, int32_t*,
+                   int32_t*, int32_t*);
+}
+
+namespace {
+
+struct Buffers {
+  std::vector<int32_t> cand, hist, hlen, labels;
+  Buffers(int64_t steps, int64_t clients, int64_t bsz, int64_t cwidth,
+          int64_t hwidth)
+      : cand(steps * clients * bsz * cwidth),
+        hist(steps * clients * bsz * hwidth),
+        hlen(steps * clients * bsz),
+        labels(steps * clients * bsz) {}
+};
+
+}  // namespace
+
+int main() {
+  const int64_t n = 257, max_pool = 12, max_his = 10, bsz = 16, npratio = 4;
+  const int64_t clients = 4;
+
+  std::vector<int32_t> pos(n), neg_pools(n * max_pool), neg_lens(n),
+      history(n * max_his), his_len(n);
+  uint64_t s = 42;
+  auto rnd = [&]() {  // splitmix64, local copy — just filler data
+    uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  };
+  for (int64_t i = 0; i < n; ++i) {
+    pos[i] = 1 + (int32_t)(rnd() % 199);
+    neg_lens[i] = 1 + (int32_t)(rnd() % max_pool);
+    for (int64_t j = 0; j < neg_lens[i]; ++j)
+      neg_pools[i * max_pool + j] = 1 + (int32_t)(rnd() % 199);
+    his_len[i] = (int32_t)(rnd() % (max_his + 1));
+    for (int64_t j = 0; j < his_len[i]; ++j)
+      history[i * max_his + j] = 1 + (int32_t)(rnd() % 199);
+  }
+
+  void* h = frd_create(pos.data(), neg_pools.data(), neg_lens.data(),
+                       history.data(), his_len.data(), n, max_pool, max_his,
+                       bsz, npratio, /*shuffle=*/1, /*drop_remainder=*/0, 7);
+  if (!h) {
+    std::fprintf(stderr, "frd_create failed\n");
+    return 2;
+  }
+  const int64_t steps = frd_num_batches(h, clients);
+  const int64_t cw = 1 + npratio, hw = max_his;
+
+  // --- 1+3: threaded epoch fill == single-threaded epoch fill, all epochs
+  for (int64_t epoch = 0; epoch < 3; ++epoch) {
+    Buffers threaded(steps, clients, bsz, cw, hw);
+    Buffers serial(steps, clients, bsz, cw, hw);
+    if (frd_fill_epoch(h, epoch, clients, 8, threaded.cand.data(),
+                       threaded.hist.data(), threaded.hlen.data(),
+                       threaded.labels.data()) ||
+        frd_fill_epoch(h, epoch, clients, 1, serial.cand.data(),
+                       serial.hist.data(), serial.hlen.data(),
+                       serial.labels.data())) {
+      std::fprintf(stderr, "frd_fill_epoch failed (epoch %ld)\n", (long)epoch);
+      return 2;
+    }
+    if (std::memcmp(threaded.cand.data(), serial.cand.data(),
+                    threaded.cand.size() * sizeof(int32_t)) ||
+        std::memcmp(threaded.hist.data(), serial.hist.data(),
+                    threaded.hist.size() * sizeof(int32_t))) {
+      std::fprintf(stderr, "threaded fill diverged from serial (epoch %ld)\n",
+                   (long)epoch);
+      return 3;
+    }
+  }
+
+  // --- 2: hammer the epoch-order cache from many threads, distinct epochs
+  {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < 8; ++t) {
+      pool.emplace_back([&, t]() {
+        std::vector<int32_t> cand(clients * bsz * cw), hist(clients * bsz * hw),
+            hlen(clients * bsz), labels(clients * bsz);
+        for (int64_t e = 0; e < 16; ++e) {
+          // epoch differs per thread AND iteration — constant rebuilds
+          int64_t epoch = (e * 8 + t) % 11;
+          int64_t b = (e + t) % steps;
+          if (frd_fill_batch(h, epoch, b, clients, cand.data(), hist.data(),
+                             hlen.data(), labels.data())) {
+            std::fprintf(stderr, "frd_fill_batch failed\n");
+            std::exit(2);
+          }
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+
+  frd_destroy(h);
+  std::puts("race_test: ok");
+  return 0;
+}

@@ -146,3 +146,4 @@ def test_wrap_around_padding_when_batch_exceeds_shard():
     (nb,) = list(nat.epoch_batches(0))
     np.testing.assert_array_equal(nb.candidates[:, 0], pb.candidates[:, 0])
     np.testing.assert_array_equal(nb.history, pb.history)
+

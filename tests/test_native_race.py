@@ -1,0 +1,32 @@
+"""ThreadSanitizer coverage for the native data engine.
+
+Separate from test_native_batcher.py on purpose: that module skips
+entirely when the prebuilt ctypes .so is absent, but this test builds
+its own TSAN binary and must run regardless.
+"""
+
+import pytest
+def test_native_engine_tsan_clean():
+    """Build the engine + stress harness under ThreadSanitizer and run it:
+    threaded epoch fill, concurrent epoch-order cache rebuilds, and
+    threaded-vs-serial determinism, with zero TSAN reports (the reference
+    ships no race detection at all — SURVEY.md section 5.2)."""
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    native = Path(__file__).resolve().parent.parent / "native"
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(
+        ["make", "-C", str(native), "race_test"], capture_output=True, text=True
+    )
+    if build.returncode != 0:
+        pytest.skip(f"TSAN build unavailable: {build.stderr[-300:]}")
+    run = subprocess.run(
+        [str(native / "race_test")], capture_output=True, text=True, timeout=300
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "ThreadSanitizer" not in run.stderr, run.stderr[-2000:]
+    assert "race_test: ok" in run.stdout
+
