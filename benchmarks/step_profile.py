@@ -35,6 +35,7 @@ def main() -> int:
 
     if jax.devices()[0].platform == "cpu":
         print("needs the TPU (honest timing assumptions)", file=sys.stderr)
+        return 1
 
     cfg = ExperimentConfig()
     cfg.model.dtype = "bfloat16"

@@ -95,7 +95,11 @@ int main() {
     if (std::memcmp(threaded.cand.data(), serial.cand.data(),
                     threaded.cand.size() * sizeof(int32_t)) ||
         std::memcmp(threaded.hist.data(), serial.hist.data(),
-                    threaded.hist.size() * sizeof(int32_t))) {
+                    threaded.hist.size() * sizeof(int32_t)) ||
+        std::memcmp(threaded.hlen.data(), serial.hlen.data(),
+                    threaded.hlen.size() * sizeof(int32_t)) ||
+        std::memcmp(threaded.labels.data(), serial.labels.data(),
+                    threaded.labels.size() * sizeof(int32_t))) {
       std::fprintf(stderr, "threaded fill diverged from serial (epoch %ld)\n",
                    (long)epoch);
       return 3;
