@@ -33,6 +33,13 @@ class DataConfig:
     # native/fedrec_data.cpp). Falls back to the Python batcher if the
     # library is unavailable.
     native_loader: bool = False
+    # static bound on unique news encoded per joint-mode step. 0 = the exact
+    # worst case B*(C+H). Real batches hold far fewer distinct ids (history
+    # padding collapses to one <unk> row; popular news repeat), so a cap cuts
+    # text-tower FLOPs proportionally. Exact while the batch's distinct count
+    # stays <= cap; the step emits a `unique_overflow` metric (count of
+    # clients whose batch overflowed — results invalid if ever nonzero).
+    unique_news_cap: int = 0
 
 
 @dataclass

@@ -88,17 +88,25 @@ def _batch_news_vecs(
     token_states: jnp.ndarray,
     candidates: jnp.ndarray,
     history: jnp.ndarray,
+    cap: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Encode the batch's unique news once; gather into cand/history slots.
 
     ``token_states``: (N_news, L, bert_hidden) HBM-resident feature table.
     Returns cand_vecs (B, C, D) and his_vecs (B, H, D).
+
+    ``cap`` (``data.unique_news_cap``): static bound on the unique slots
+    actually encoded — the worst case B*(C+H) wastes text-tower FLOPs on
+    duplicate/padding rows. Exact while distinct ids <= cap; callers must
+    surface :func:`unique_overflow` when setting it.
     """
     b, c = candidates.shape
     h = history.shape[1]
     ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
     n_news = token_states.shape[0]
     size = min(ids.shape[0], n_news)
+    if cap:
+        size = min(size, cap)
     uniq, inv = jnp.unique(
         ids, size=size, fill_value=0, return_inverse=True
     )
@@ -112,6 +120,25 @@ def _batch_news_vecs(
     cand_vecs = flat[: b * c].reshape(b, c, -1)
     his_vecs = flat[b * c :].reshape(b, h, -1)
     return cand_vecs, his_vecs
+
+
+def unique_overflow(
+    candidates: jnp.ndarray,
+    history: jnp.ndarray,
+    cap: int,
+    n_news: int,
+) -> jnp.ndarray:
+    """1 when this batch's distinct news ids exceed the static ``cap``.
+
+    ``jnp.unique(size=cap)`` silently drops ids past the cap, corrupting the
+    gather — so a capped step must emit this flag; any nonzero value in
+    training metrics means the cap is too small and results are invalid.
+    """
+    ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
+    sorted_ids = jnp.sort(ids)
+    distinct = 1 + jnp.sum((jnp.diff(sorted_ids) != 0).astype(jnp.int32))
+    bound = min(cap, ids.shape[0], n_news)
+    return (distinct > bound).astype(jnp.int32)
 
 
 def _encode_unique_tokens(
@@ -382,6 +409,7 @@ def build_fed_train_step(
                         cand_vecs, his_vecs = _batch_news_vecs(
                             model, news_params, table,
                             batch["candidates"], batch["history"],
+                            cap=cfg.data.unique_news_cap,
                         )
                     if n_seq > 1:
                         # candidate encoding is replicated across seq shards;
@@ -477,7 +505,18 @@ def build_fed_train_step(
             raise ValueError(f"unknown step mode {mode!r}")
 
         mean_loss = lax.pmean(loss, axis_name=axis)
-        return new_state, {"loss": loss, "mean_loss": mean_loss}
+        metrics = {"loss": loss, "mean_loss": mean_loss}
+        if mode == "joint" and cfg.data.unique_news_cap and not use_dpsgd:
+            # ids are data, not params — computed outside the grad closure;
+            # any nonzero total means the cap corrupted this step. (Under
+            # DP-SGD the cap is inert — each example encodes its own ids —
+            # so no flag is emitted there.)
+            flag = unique_overflow(
+                batch["candidates"], batch["history"],
+                cfg.data.unique_news_cap, table.shape[0],
+            )
+            metrics["unique_overflow"] = lax.psum(flag, axis_name=axis)
+        return new_state, metrics
 
     if n_seq > 1:
         # history's last dim lives sharded over the seq axis; the step then
@@ -597,6 +636,9 @@ def build_param_sync(
         new_news = strategy.sync_params(state.news_params, w, axis)
         return _restack(state.replace(user_params=new_user, news_params=new_news))
 
+    # NOT donated (unlike the train step): sync runs once per round, so the
+    # transient double-buffer is cheap, and callers legitimately hold the
+    # pre-sync state for comparisons (e.g. the local-strategy identity test)
     return jax.jit(sharded_sync)
 
 

@@ -232,6 +232,7 @@ class Trainer:
         )
 
         losses = []
+        overflows = []  # device arrays; read once at round end (no per-step sync)
         for local_epoch in range(cfg.fed.local_epochs):
             epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
             table = self._feature_table()
@@ -249,6 +250,8 @@ class Trainer:
                 )
                 self.state, metrics = self.train_step(self.state, sharded, table)
                 losses.append(metrics["mean_loss"])
+                if "unique_overflow" in metrics:
+                    overflows.append(metrics["unique_overflow"])
             if self.mode == "decoupled":
                 self.state, tables = self.news_update(self.state, self.token_states)
                 self._table = jax.tree_util.tree_map(lambda x: x[0], tables)
@@ -258,6 +261,16 @@ class Trainer:
             if self.mode == "decoupled":
                 self._refresh_table()
 
+        if overflows:
+            total = int(np.sum([np.max(np.asarray(o)) for o in overflows]))
+            if total > 0:
+                raise RuntimeError(
+                    f"data.unique_news_cap={cfg.data.unique_news_cap} "
+                    f"overflowed on {total} step(s) this round — the capped "
+                    "unique-news dedup dropped ids and the gradients are "
+                    "invalid. Raise the cap (or set it to 0 for the exact "
+                    "worst-case bound)."
+                )
         train_loss = float(np.mean([np.mean(np.asarray(l)) for l in losses]))
         result = RoundResult(round_idx, train_loss)
         if self.valid_ix is not None and (round_idx + 1) % self.cfg.train.eval_every == 0:

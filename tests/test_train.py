@@ -249,3 +249,39 @@ def test_local_strategy_param_sync_is_identity():
 def test_popular_frac_validation():
     with pytest.raises(ValueError, match="popular_frac"):
         make_synthetic_mind(num_news=10, popular_frac=0.95)
+
+
+def test_unique_news_cap_exact_below_cap_and_flags_overflow():
+    """A cap >= the batch's distinct ids must be bit-identical to the exact
+    step; a too-small cap must raise the unique_overflow metric (and never
+    crash)."""
+    cfg = small_cfg()
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    strategy = get_strategy("grad_avg")
+    b = next(iter(batcher.epoch_batches_sharded(cfg.fed.num_clients, 0)))
+    batch = shard_batch(mesh, _batch_dict(b))
+
+    step_exact = build_fed_train_step(model, cfg, strategy, mesh, mode="joint")
+    s_exact, m_exact = step_exact(stacked, batch, token_states)
+
+    # cap BELOW min(ids, num_news)=64 so the size-shrinking path actually
+    # runs, but above this seed's distinct count (~54) so it stays exact
+    cfg_cap = small_cfg()
+    cfg_cap.data.unique_news_cap = 60
+    step_cap = build_fed_train_step(model, cfg_cap, strategy, mesh, mode="joint")
+    s_cap, m_cap = step_cap(stacked, batch, token_states)
+    assert int(np.max(np.asarray(m_cap["unique_overflow"]))) == 0
+    np.testing.assert_allclose(
+        np.asarray(m_cap["loss"]), np.asarray(m_exact["loss"]), rtol=1e-6
+    )
+    for a, e in zip(
+        jax.tree_util.tree_leaves(s_cap.user_params),
+        jax.tree_util.tree_leaves(s_exact.user_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=1e-6)
+
+    cfg_tiny = small_cfg()
+    cfg_tiny.data.unique_news_cap = 4  # far below any batch's distinct count
+    step_tiny = build_fed_train_step(model, cfg_tiny, strategy, mesh, mode="joint")
+    _, m_tiny = step_tiny(stacked, batch, token_states)
+    assert int(np.max(np.asarray(m_tiny["unique_overflow"]))) > 0

@@ -470,3 +470,18 @@ def test_coordinator_cli_two_process(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
         assert "done after 2 rounds" in out
+
+
+def test_trainer_raises_on_unique_cap_overflow(tmp_path):
+    """A too-small data.unique_news_cap must abort the round loudly —
+    jnp.unique(size=cap) silently drops ids past the cap, so a run that kept
+    going would train on corrupted gathers."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.model.text_encoder_mode = "head"
+    cfg.data.unique_news_cap = 4  # far below any batch's distinct ids
+    data, token_states = tiny_data(cfg)
+    trainer = Trainer(cfg, data, token_states)
+    with pytest.raises(RuntimeError, match="unique_news_cap"):
+        trainer.train_round(0)
