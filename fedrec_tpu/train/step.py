@@ -147,13 +147,19 @@ def _encode_unique_tokens(
     tokens_table: jnp.ndarray,
     ids: jnp.ndarray,
     dropout_rng: jax.Array | None,
+    cap: int = 0,
 ) -> jnp.ndarray:
     """Encode a flat id vector's unique news through the full TextEncoder.
 
     Gathers the unique token rows from the (N, 2, L) table, runs trunk +
     head once per distinct news, and scatters back to (len(ids), D).
+    ``cap`` bounds the unique slots like in :func:`_batch_news_vecs` — it
+    matters MOST here, where every slot pays a full trunk forward+backward;
+    callers must surface :func:`unique_overflow`.
     """
     size = min(ids.shape[0], tokens_table.shape[0])
+    if cap:
+        size = min(size, cap)
     uniq, inv = jnp.unique(ids, size=size, fill_value=0, return_inverse=True)
     toks = tokens_table[uniq]  # (size, 2, L)
     train = dropout_rng is not None
@@ -173,6 +179,7 @@ def _batch_news_vecs_tokens(
     candidates: jnp.ndarray,
     history: jnp.ndarray,
     dropout_rng: jax.Array | None,
+    cap: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Finetune-mode analogue of ``_batch_news_vecs``: one joint dedup over
     candidate + history ids, full trainable TextEncoder on the unique rows."""
@@ -180,7 +187,7 @@ def _batch_news_vecs_tokens(
     h = history.shape[1]
     ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
     flat = _encode_unique_tokens(
-        text_encoder, news_params, tokens_table, ids, dropout_rng
+        text_encoder, news_params, tokens_table, ids, dropout_rng, cap=cap
     )
     cand_vecs = flat[: b * c].reshape(b, c, -1)
     his_vecs = flat[b * c :].reshape(b, h, -1)
@@ -404,6 +411,7 @@ def build_fed_train_step(
                         cand_vecs, his_vecs = _batch_news_vecs_tokens(
                             text_encoder, news_params, table,
                             batch["candidates"], batch["history"], enc_rng,
+                            cap=cfg.data.unique_news_cap,
                         )
                     else:
                         cand_vecs, his_vecs = _batch_news_vecs(
@@ -506,11 +514,17 @@ def build_fed_train_step(
 
         mean_loss = lax.pmean(loss, axis_name=axis)
         metrics = {"loss": loss, "mean_loss": mean_loss}
-        if mode == "joint" and cfg.data.unique_news_cap and not use_dpsgd:
+        capped = (
+            cfg.data.unique_news_cap
+            and not use_dpsgd
+            and (mode == "joint" or (mode == "finetune" and n_seq == 1))
+        )
+        if capped:
             # ids are data, not params — computed outside the grad closure;
             # any nonzero total means the cap corrupted this step. (Under
             # DP-SGD the cap is inert — each example encodes its own ids —
-            # so no flag is emitted there.)
+            # and the seq-parallel finetune path encodes rows separately,
+            # bypassing the capped joint dedup — so no flag there.)
             flag = unique_overflow(
                 batch["candidates"], batch["history"],
                 cfg.data.unique_news_cap, table.shape[0],
