@@ -140,9 +140,13 @@ def main(argv: list[str] | None = None) -> int:
         if trains:
             result = trainer.train_round(round_idx)
 
-        # gather: participation weight 0 for a non-training server
+        # gather: participation weight 0 for a non-training server; with
+        # fed.weight_by_samples each client counts by its shard size
+        # (classic FedAvg) instead of the reference's unweighted key-wise
+        # mean over unequal shards (server.py:37-55)
         u0, n0 = trainer._client0_params()
-        u, n = rt.aggregate((u0, n0), participated=trains)
+        w = float(len(data.train_samples)) if cfg.fed.weight_by_samples else 1.0
+        u, n = rt.aggregate((u0, n0), participated=trains, weight=w)
         trainer.set_global_params(u, n)
 
         if result is not None:

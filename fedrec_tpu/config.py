@@ -105,6 +105,11 @@ class FedConfig:
     local_epochs: int = 1              # client epochs per round
     rounds: int = 10                   # global rounds (server.py global_epochs)
     participation: float = 1.0         # fraction of clients aggregated per round
+    # classic FedAvg weighting by client example count in coordinator mode
+    # (McMahan et al.); False = reference parity — the server's key-wise
+    # UNWEIGHTED mean over whatever shard sizes clients hold
+    # (reference server.py:37-55)
+    weight_by_samples: bool = False
     mesh_axis: str = "clients"
     # sequence/context parallelism for long click-histories: shard the history
     # axis over `seq_shards` chips per client and attend via ring or Ulysses

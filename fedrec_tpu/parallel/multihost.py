@@ -177,11 +177,17 @@ class CoordinatorRuntime:
             lambda: params,
         )
 
-    def aggregate(self, params: Any, participated: bool = True) -> Any:
+    def aggregate(
+        self, params: Any, participated: bool = True, weight: float = 1.0
+    ) -> Any:
+        """Weighted FedAvg across processes. ``weight`` is this process's
+        aggregation mass (e.g. its example count for classic FedAvg);
+        non-participants contribute 0 regardless."""
         if self.num_processes == 1:
             return params
+        w = float(weight) if participated else 0.0
         return self._collective(
-            lambda: aggregate_from_hosts(params, 1.0 if participated else 0.0),
+            lambda: aggregate_from_hosts(params, w),
             lambda: params,
         )
 

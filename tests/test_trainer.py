@@ -504,3 +504,54 @@ def test_trainer_finetune_respects_unique_cap(tmp_path):
     trainer_bad = Trainer(cfg_bad, data, token_states=None)
     with pytest.raises(RuntimeError, match="unique_news_cap"):
         trainer_bad.train_round(0)
+
+
+WEIGHTED_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    from fedrec_tpu.parallel.multihost import CoordinatorRuntime, initialize_distributed
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    rt = CoordinatorRuntime(collective_timeout_s=30.0)
+    params = {"w": np.full((4,), float(pid + 1), np.float32)}
+    # classic FedAvg: process 0 weighs 1 sample, process 1 weighs 3
+    agg = rt.aggregate(params, weight=float(1 + 2 * pid))
+    want = (1.0 * 1 + 2.0 * 3) / 4.0  # = 1.75
+    assert np.allclose(agg["w"], want), agg["w"]
+    print(f"WEIGHTED_OK {pid}", flush=True)
+    """
+)
+
+
+def test_coordinator_aggregate_weight_by_samples(tmp_path):
+    """aggregate(weight=n_k) reproduces the classic FedAvg weighted mean
+    (the reference's server averages state_dicts UNWEIGHTED over unequal
+    shards, server.py:37-55 — kept as the default for parity)."""
+    port = _free_port()
+    script = tmp_path / "weighted_worker.py"
+    script.write_text(WEIGHTED_WORKER)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid)],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    for pid, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail("weighted aggregate worker timed out")
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WEIGHTED_OK {pid}" in out
