@@ -36,4 +36,4 @@ Package layout:
   cli        — entry points mirroring the reference's driver scripts
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
