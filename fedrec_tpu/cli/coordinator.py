@@ -171,6 +171,15 @@ def main(argv: list[str] | None = None) -> int:
                             {"user": u, "news": n, "round": round_idx}
                         )
                     )
+                    # retention: mirror orbax's max_to_keep=3 — the reference
+                    # leaves received_model_{i}.pt files piling up forever
+                    # (server.py:27)
+                    kept = sorted(
+                        snapshot_dir.glob("global_round_*.msgpack"),
+                        key=lambda p: int(p.stem.rsplit("_", 1)[1]),
+                    )
+                    for old in kept[:-3]:
+                        old.unlink(missing_ok=True)
         round_idx += 1
 
     print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
