@@ -193,16 +193,20 @@ def leg_fed(rounds: int) -> None:
 
     data, states = _small_corpus()
     runs = {}
-    for name, (strategy, clients, dp_eps) in {
-        "local_1client": ("local", 1, None),
-        "param_avg_8": ("param_avg", 8, None),
-        "grad_avg_8": ("grad_avg", 8, None),
+    for name, (strategy, clients, dp_eps, mode) in {
+        "local_1client": ("local", 1, None, "head"),
+        # the reference's actual epoch structure: user tower trains on a
+        # precomputed news-vec table, text head updates from accumulated
+        # embedding grads at epoch end (reference model.py:66-90)
+        "decoupled_1client": ("local", 1, None, "table"),
+        "param_avg_8": ("param_avg", 8, None, "head"),
+        "grad_avg_8": ("grad_avg", 8, None, "head"),
         # two epsilons -> a privacy-utility tradeoff, not one crushed point
-        "param_avg_8_dp50": ("param_avg", 8, 50.0),
-        "param_avg_8_dp10": ("param_avg", 8, 10.0),
+        "param_avg_8_dp50": ("param_avg", 8, 50.0, "head"),
+        "param_avg_8_dp10": ("param_avg", 8, 10.0, "head"),
     }.items():
         cfg = ExperimentConfig()
-        cfg.model.text_encoder_mode = "head"
+        cfg.model.text_encoder_mode = mode
         cfg.model.news_dim = 64
         cfg.model.num_heads = 8
         cfg.model.head_dim = 8
