@@ -18,9 +18,10 @@ On TPU the run additionally reports:
     with per-step gradient averaging all clients stay in lockstep, so 8
     clients x B=64 on one chip is mathematically one B=512 step).
 
-The accelerator probe retries with backoff before falling back to CPU — the
-tunnel to the chip can be transiently wedged, and a CPU number must be the
-last resort, clearly labeled via the ``platform`` field.
+The accelerator probe compiles+runs a real op (not just a device listing) and
+distinguishes transient rendezvous stalls (retried with backoff) from a
+wedged remote compile (definitive — fall back immediately); a CPU number is
+the last resort, clearly labeled via the ``platform`` field.
 """
 
 from __future__ import annotations
@@ -46,11 +47,20 @@ _PEAK_FLOPS = {
 }
 
 
-def _probe_accelerator(attempts: int = 3, timeout_s: int = 120) -> bool:
-    """True when ``jax.devices()`` initializes a non-CPU backend in time.
+def _probe_accelerator(attempts: int = 3, timeout_s: int = 150) -> bool:
+    """True when a non-CPU backend can actually COMPILE AND RUN an op in time.
 
-    Runs in a subprocess (a wedged tunnel hangs the whole process, not just
-    the call) and retries with backoff — transient tunnel stalls are common.
+    Listing devices is not enough: the observed tunnel failure mode is a
+    responsive device query with a wedged remote compile (``jax.devices()``
+    returns in seconds, then the first jitted op hangs forever). The probe
+    therefore compiles+runs a real matmul — on a healthy tunnel that takes
+    ~10-20 s. Timeouts are disambiguated by a ``DEVOK`` marker the child
+    prints after the device query: a hang *before* the marker is a stalled
+    rendezvous (the transient kind — retried with backoff, like quick
+    backend-init raises), while a hang *after* it is the wedged-compile mode,
+    which past evidence says persists for hours — treated as definitive so
+    one window, not the full bench watchdog, is burned. Runs in a subprocess
+    because a wedge hangs the whole process, not just the call.
     """
     for i in range(attempts):
         try:
@@ -58,8 +68,12 @@ def _probe_accelerator(attempts: int = 3, timeout_s: int = 120) -> bool:
                 [
                     sys.executable,
                     "-c",
-                    "import jax; d = jax.devices(); "
-                    "import sys; sys.exit(0 if d[0].platform != 'cpu' else 3)",
+                    "import jax, jax.numpy as jnp, sys; "
+                    "d = jax.devices(); "
+                    "print('DEVOK', flush=True); "
+                    "sys.exit(3) if d[0].platform == 'cpu' else None; "
+                    "x = jnp.ones((256, 256), jnp.bfloat16); "
+                    "float((x @ x).sum()); sys.exit(0)",
                 ],
                 timeout=timeout_s,
                 capture_output=True,
@@ -68,8 +82,9 @@ def _probe_accelerator(attempts: int = 3, timeout_s: int = 120) -> bool:
                 return True
             if proc.returncode == 3:
                 return False  # definitive CPU-only answer; don't retry
-        except subprocess.TimeoutExpired:
-            pass
+        except subprocess.TimeoutExpired as e:
+            if b"DEVOK" in (e.stdout or b""):
+                return False  # wedged compile; more windows won't unwedge it
         if i < attempts - 1:
             time.sleep(10 * (i + 1))
     return False
