@@ -32,6 +32,7 @@ Package layout:
   privacy    — DP-SGD + RDP accountant (replaces Opacus)
   train      — the single Trainer (ends the reference's 4-way copy-paste)
   eval       — ranking metrics (AUC/MRR/NDCG) host- and device-side
+  serve      — batched jitted top-k recommendation over the news table
   utils      — PRNG, logging, profiling helpers
   cli        — entry points mirroring the reference's driver scripts
 """

@@ -1,0 +1,68 @@
+"""Batched top-k recommendation over the news table — the serving path.
+
+The reference stops at validation (``client.py:149-171``); it has no way to
+actually produce recommendations for a user. A recommender framework needs
+one, so this closes the loop: given trained user-tower params and the
+``(N, D)`` news-vector table (from ``encode_all_news`` /
+``encode_corpus_tokens``), score EVERY news item for a batch of users in one
+jitted program and return the top-k ids and scores.
+
+TPU shape: the full-catalog scoring is a single ``(B, D) x (D, N)`` matmul —
+MXU-friendly at any realistic catalog size (MIND-small: N≈65k, D=400 →
+26 MFLOP/user) — followed by an in-HBM masked ``lax.top_k``. No host
+round-trips besides the final (B, k) result.
+
+History items are excluded by default (recommending something the user just
+read is a wasted slot); id 0 — the reference's history pad slot
+(``dataset.py:83-85``) — is always excluded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fedrec_tpu.models import NewsRecommender
+
+_NEG = jnp.finfo(jnp.float32).min
+
+
+def build_recommend_fn(
+    model: NewsRecommender,
+    top_k: int = 10,
+    exclude_history: bool = True,
+) -> Callable:
+    """Compile ``recommend(user_params, news_vecs, history) -> (ids, scores)``.
+
+    ``history``: (B, H) int32 clicked-news ids, 0-padded like training
+    batches. Returns ``ids`` (B, k) int32 and ``scores`` (B, k) float32,
+    best first, with ``k = min(top_k, N)``. When fewer than ``k`` valid
+    items exist (tiny catalog, long history), the tail slots carry id ``-1``
+    and the float32-min sentinel score — callers truncate at the first -1.
+    """
+
+    def recommend(user_params: Any, news_vecs: jnp.ndarray, history: jnp.ndarray):
+        his_vecs = news_vecs[history]  # (B, H, D)
+        user_vec = model.apply(
+            {"params": {"user_encoder": user_params}},
+            his_vecs,
+            method=NewsRecommender.encode_user,
+        )  # (B, D)
+        scores = jnp.einsum(
+            "bd,nd->bn", user_vec.astype(jnp.float32), news_vecs.astype(jnp.float32)
+        )
+        n = news_vecs.shape[0]
+        # drop the pad slot, and (optionally) everything already clicked
+        invalid = jnp.zeros((history.shape[0], n), bool).at[:, 0].set(True)
+        if exclude_history:
+            rows = jnp.arange(history.shape[0])[:, None]
+            invalid = invalid.at[rows, history].set(True)
+        scores = jnp.where(invalid, _NEG, scores)
+        top_scores, top_ids = lax.top_k(scores, min(top_k, n))
+        top_ids = jnp.where(top_scores <= _NEG, -1, top_ids)
+        return top_ids.astype(jnp.int32), top_scores
+
+    return jax.jit(recommend)
