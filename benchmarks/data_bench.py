@@ -1,0 +1,117 @@
+"""Host data-pipeline benchmark: Python batcher vs native C++ engine.
+
+The reference feeds training through torch ``DataLoader`` workers
+(``main.py:166``); our equivalent host-side hot loop — epoch shuffle,
+round-robin client sharding, negative sampling, static-shape batch packing —
+has two implementations: the numpy ``TrainBatcher`` and the threaded C++
+engine (``native/fedrec_data.cpp`` via ``NativeTrainBatcher``). This
+benchmark records what the native engine buys on a MIND-scale epoch, since
+on TPU the host pipeline is what must keep the chip fed.
+
+Writes ``benchmarks/data_bench.json`` and prints one JSON line.
+Usage: python benchmarks/data_bench.py [--samples 200000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+HERE = Path(__file__).resolve().parent
+REPO = HERE.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def make_indexed(n_samples: int, num_news: int, pool: int, his: int, seed: int = 0):
+    from fedrec_tpu.data.batcher import IndexedSamples
+
+    rng = np.random.default_rng(seed)
+    neg_lens = rng.integers(4, pool + 1, size=n_samples).astype(np.int32)
+    pools = rng.integers(1, num_news, size=(n_samples, pool)).astype(np.int32)
+    pools[np.arange(pool)[None, :] >= neg_lens[:, None]] = 0
+    his_len = rng.integers(1, his + 1, size=n_samples).astype(np.int32)
+    hist = rng.integers(1, num_news, size=(n_samples, his)).astype(np.int32)
+    hist[np.arange(his)[None, :] >= his_len[:, None]] = 0
+    return IndexedSamples(
+        pos=rng.integers(1, num_news, size=n_samples).astype(np.int32),
+        neg_pools=pools,
+        neg_lens=neg_lens,
+        history=hist,
+        his_len=his_len,
+    )
+
+
+def time_call(fn, repeats: int = 3) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--samples", type=int, default=200_000)
+    p.add_argument("--num-news", type=int, default=65_000)  # MIND-small scale
+    p.add_argument("--pool", type=int, default=40)
+    p.add_argument("--his", type=int, default=50)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--threads", type=int, default=8)
+    args = p.parse_args()
+
+    from fedrec_tpu.data.batcher import TrainBatcher
+    from fedrec_tpu.data.native_batcher import NativeTrainBatcher, is_available
+
+    indexed = make_indexed(args.samples, args.num_news, args.pool, args.his)
+    n_eff = (args.samples // args.batch) * args.batch  # drop_remainder parity
+
+    py = TrainBatcher(indexed, batch_size=args.batch, seed=1)
+    t_py = time_call(lambda: sum(1 for _ in py.epoch_batches(0)))
+
+    out = {
+        "metric": "data_pipeline_epoch_assembly",
+        "unit": "samples/sec",
+        "samples": args.samples,
+        "batch": args.batch,
+        "pool": args.pool,
+        "his": args.his,
+        "python_batcher": round(n_eff / t_py, 1),
+    }
+
+    if is_available():
+        nb = NativeTrainBatcher(indexed, batch_size=args.batch, seed=1)
+        t_n1 = time_call(lambda: sum(1 for _ in nb.epoch_batches(0)))
+        out["native_batcher"] = round(n_eff / t_n1, 1)
+
+        nb_s = NativeTrainBatcher(
+            indexed, batch_size=args.batch, seed=1, num_threads=args.threads
+        )
+        n_shard = (
+            nb_s._steps(args.clients) * args.clients * args.batch
+        )  # samples packed per sharded epoch
+        t_ep = time_call(lambda: nb_s.epoch_arrays_sharded(args.clients, 0))
+        out["native_epoch_threaded"] = round(n_shard / t_ep, 1)
+        out["clients"] = args.clients
+        out["threads"] = args.threads
+        out["speedup_native"] = round(out["native_batcher"] / out["python_batcher"], 2)
+        out["speedup_threaded"] = round(
+            out["native_epoch_threaded"] / out["python_batcher"], 2
+        )
+    else:
+        out["native_batcher"] = None
+
+    (HERE / "data_bench.json").write_text(json.dumps(out, indent=2))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

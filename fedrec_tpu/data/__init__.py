@@ -3,6 +3,7 @@ from fedrec_tpu.data.mind import (
     load_mind_artifacts,
     make_synthetic_mind,
     make_synthetic_mind_topics,
+    token_states_from_tokens,
 )
 from fedrec_tpu.data.sampling import newsample
 from fedrec_tpu.data.batcher import (
@@ -12,7 +13,11 @@ from fedrec_tpu.data.batcher import (
     index_samples,
     shard_indices,
 )
-from fedrec_tpu.data.adressa import parse_adressa_events, preprocess_adressa
+from fedrec_tpu.data.adressa import (
+    make_synthetic_adressa_events,
+    parse_adressa_events,
+    preprocess_adressa,
+)
 from fedrec_tpu.data.native_batcher import (
     NativeTrainBatcher,
     is_available as native_batcher_available,
@@ -44,6 +49,7 @@ __all__ = [
     "index_samples",
     "load_mind_artifacts",
     "make_synthetic_mind",
+    "make_synthetic_adressa_events",
     "make_synthetic_mind_topics",
     "newsample",
     "parse_adressa_events",
@@ -52,5 +58,6 @@ __all__ = [
     "parse_news_tsv",
     "preprocess_mind",
     "shard_indices",
+    "token_states_from_tokens",
     "write_artifacts",
 ]

@@ -220,3 +220,31 @@ def make_synthetic_mind_topics(
         news_tokens, nid2index, _make(num_train, 0), _make(num_valid, num_train)
     )
     return data, token_states
+
+
+def token_states_from_tokens(
+    news_tokens: np.ndarray,
+    bert_hidden: int = 96,
+    vocab: int = 30_522,
+    seed: int = 0,
+    dtype=np.float32,
+) -> np.ndarray:
+    """(N, 2, L) token table -> (N, L, bert_hidden) "frozen random trunk".
+
+    A deterministic surrogate for cached DistilBERT states when no
+    pretrained trunk is available offline: every token id maps to a fixed
+    Gaussian embedding, masked positions are zeroed. Lexical structure in
+    the titles (shared topic words) therefore survives into the states, so
+    ``text_encoder_mode='head'`` can learn from corpora produced by the
+    real tokenizer/pipeline (the Adressa accuracy leg uses this). Not a
+    language model — just the weakest trunk that preserves word identity.
+    """
+    ids = news_tokens[:, 0, :]
+    # cover any tokenizer's id space (e.g. Norwegian BERT ~50k > the BERT
+    # default); extending the table leaves ids < vocab with identical rows
+    table = np.random.default_rng(seed).standard_normal(
+        (max(vocab, int(ids.max()) + 1), bert_hidden), dtype=np.float32
+    )
+    mask = news_tokens[:, 1, :, None].astype(np.float32)
+    states = table[ids] * mask
+    return states.astype(dtype) if np.dtype(dtype) != np.float32 else states

@@ -118,3 +118,40 @@ def test_time_field_edge_cases(tmp_path):
     _, clicks = parse_adressa_events([path])
     # numeric-string time coerced and ordered after the int time
     assert [n for _, n in clicks["u"]] == ["n4", "n3"]
+
+
+def test_synthetic_events_signal_survives_pipeline(tmp_path):
+    """The synthetic event generator's topic signal must survive the REAL
+    pipeline (tokenizer -> news index -> chronological split): the oracle
+    centroid scorer on token-derived states beats random by a wide margin,
+    and the artifacts are schema-valid."""
+    from fedrec_tpu.data import (
+        make_synthetic_adressa_events,
+        token_states_from_tokens,
+    )
+
+    events = make_synthetic_adressa_events(num_users=150, num_news=300, seed=4)
+    path = tmp_path / "ev.jsonl"
+    _write_events(path, events)
+    data = preprocess_adressa(
+        [path], out_dir=None, max_title_len=12, neg_pool_size=10,
+        valid_frac=0.2, seed=5,
+    )
+    assert data.nid2index["<unk>"] == 0
+    assert data.news_tokens.shape[1:] == (2, 12)
+    assert len(data.train_samples) > len(data.valid_samples) > 0
+
+    states = token_states_from_tokens(data.news_tokens, bert_hidden=64, seed=6)
+    assert states.shape == (data.num_news, 12, 64)
+    assert np.all(states[0] == 0)  # <unk> row fully masked
+
+    cent = states.mean(axis=1)
+    cent /= np.linalg.norm(cent, axis=1, keepdims=True) + 1e-9
+    n2i = data.nid2index
+    aucs = []
+    for _, pos, negs, his, _ in data.valid_samples:
+        hv = cent[[n2i[h] for h in his]].mean(0)
+        s_neg = cent[[n2i[x] for x in negs]] @ hv
+        s_pos = float(hv @ cent[n2i[pos]])
+        aucs.append((np.sum(s_pos > s_neg) + 0.5 * np.sum(s_pos == s_neg)) / len(s_neg))
+    assert np.mean(aucs) > 0.7, f"signal lost: oracle AUC {np.mean(aucs):.3f}"
