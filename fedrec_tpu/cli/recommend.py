@@ -1,0 +1,165 @@
+"""Serving driver: trained snapshot -> top-k recommendations per user.
+
+The reference framework ends at validation; an operator who trained a model
+has no way to USE it. This driver closes that gap: restore the latest
+snapshot, encode the news corpus once, and emit JSON-lines
+``{"uid": ..., "news": [nid, ...], "scores": [...]}`` for every known user
+(or a ``--uids`` subset), batched through the jitted full-catalog scorer
+(:mod:`fedrec_tpu.serve`).
+
+Each user's history is their LONGEST recorded click history across train +
+valid samples (samples carry cumulative histories, so longest = latest).
+
+Usage:
+  python -m fedrec_tpu.cli.recommend --data-dir UserData \\
+      --snapshot-dir snapshots [--top-k 10] [--out recs.jsonl] \\
+      [--uids U123 U456] [--set section.key=value]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", default="/root/reference/UserData",
+                   help="reference UserData/ artifact layout")
+    p.add_argument("--token-states", default=None,
+                   help="(N, L, bert_hidden) .npy of cached trunk states")
+    p.add_argument("--snapshot-dir", default=None,
+                   help="orbax snapshot tree (default: train.snapshot_dir)")
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--keep-history", action="store_true",
+                   help="allow already-clicked news in the output")
+    p.add_argument("--out", default="-", help="output JSONL path ('-' = stdout)")
+    p.add_argument("--uids", nargs="*", default=None,
+                   help="subset of user ids (default: every known user)")
+    p.add_argument("--batch-users", type=int, default=256)
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="SECTION.KEY=VALUE")
+    return p
+
+
+def collect_histories(data, max_his_len: int) -> dict[str, list[str]]:
+    """uid -> longest recorded history (sample schema: [uidx, pos, negs,
+    history, uid], reference ``dataset.py:81``)."""
+    best: dict[str, list[str]] = {}
+    for sample in list(data.train_samples) + list(data.valid_samples):
+        _, _, _, his, uid = sample
+        if len(his) >= len(best.get(uid, ())):
+            best[uid] = list(his)
+    return {u: h[-max_his_len:] for u, h in best.items()}
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import load_mind_artifacts
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serve import build_recommend_fn
+    from fedrec_tpu.train.checkpoint import SnapshotManager
+    from fedrec_tpu.train.step import encode_all_news, encode_corpus_tokens
+
+    cfg = ExperimentConfig()
+    cfg.apply_overrides(args.overrides)
+    snap_dir = args.snapshot_dir or cfg.train.snapshot_dir
+
+    # template-free restore: serving must not depend on the training run's
+    # client count or mesh — any (N_clients, ...) snapshot serves anywhere
+    # (after param_avg/coordinator aggregation all clients are identical;
+    # client 0 is the convention, matching Trainer._client0_params)
+    snapshots = SnapshotManager(snap_dir)
+    if snapshots.latest_round() is None:
+        print(f"[recommend] no snapshot under {snap_dir} — train first "
+              "(fedrec-run ...) or pass --snapshot-dir", file=sys.stderr)
+        return 2
+    raw = snapshots.restore_raw()
+    snapshots.close()
+    client0 = jax.tree_util.tree_map(lambda x: jnp.asarray(x[0]), raw)
+    user_params, news_params = client0["user_params"], client0["news_params"]
+
+    data = load_mind_artifacts(args.data_dir)
+    model = NewsRecommender(cfg.model)
+    mode = cfg.model.text_encoder_mode
+    if mode == "finetune":
+        from fedrec_tpu.models.bert import make_text_encoder
+
+        table = encode_corpus_tokens(
+            make_text_encoder(cfg.model), news_params,
+            jnp.asarray(data.news_tokens, jnp.int32),
+        )
+    else:
+        token_path = args.token_states or str(
+            Path(args.data_dir) / "token_states.npy"
+        )
+        if Path(token_path).exists():
+            token_states = np.load(token_path)
+        else:
+            print(f"[recommend] no token states at {token_path}; using random "
+                  "(smoke mode)", file=sys.stderr)
+            token_states = np.random.default_rng(0).standard_normal(
+                (data.num_news, data.title_len, cfg.model.bert_hidden)
+            ).astype(np.float32)
+        table = encode_all_news(
+            model, news_params,
+            jnp.asarray(token_states, jnp.dtype(cfg.model.dtype)),
+        )
+
+    histories = collect_histories(data, cfg.data.max_his_len)
+    uids = sorted(histories) if args.uids is None else args.uids
+    missing = [u for u in uids if u not in histories]
+    if missing:
+        print(f"[recommend] {len(missing)} unknown uid(s) skipped: "
+              f"{missing[:5]}...", file=sys.stderr)
+        uids = [u for u in uids if u in histories]
+    if not uids:
+        print("[recommend] no users to serve", file=sys.stderr)
+        return 2
+
+    index2nid = {i: n for n, i in data.nid2index.items()}
+    # real artifacts can carry more token rows than mapped nids (the
+    # reference demo shard: 225 rows, 139 ids) — never recommend the unmapped
+    valid = np.zeros(data.num_news, bool)
+    valid[[i for i in index2nid if 0 <= i < data.num_news]] = True
+    fn = build_recommend_fn(
+        model, top_k=args.top_k,
+        exclude_history=not args.keep_history, valid_mask=valid,
+    )
+
+    out_fh = sys.stdout if args.out == "-" else open(args.out, "w")
+    h_len = cfg.data.max_his_len
+    bu = args.batch_users
+    for start in range(0, len(uids), bu):
+        chunk = uids[start : start + bu]
+        hist = np.zeros((bu, h_len), np.int32)  # static shape: one compile
+        for r, uid in enumerate(chunk):
+            ids = [data.nid2index.get(n, 0) for n in histories[uid]]
+            hist[r, : len(ids)] = ids
+        ids_out, scores_out = fn(user_params, table, hist)
+        ids_out, scores_out = np.asarray(ids_out), np.asarray(scores_out)
+        for r, uid in enumerate(chunk):
+            keep = ids_out[r] >= 0
+            out_fh.write(json.dumps({
+                "uid": uid,
+                "news": [index2nid[int(i)] for i in ids_out[r][keep]],
+                "scores": [round(float(s), 5) for s in scores_out[r][keep]],
+            }) + "\n")
+    if out_fh is not sys.stdout:
+        out_fh.close()
+        print(f"[recommend] wrote {len(uids)} users to {args.out}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,7 @@ def build_recommend_fn(
     model: NewsRecommender,
     top_k: int = 10,
     exclude_history: bool = True,
+    valid_mask: jnp.ndarray | None = None,
 ) -> Callable:
     """Compile ``recommend(user_params, news_vecs, history) -> (ids, scores)``.
 
@@ -42,7 +43,14 @@ def build_recommend_fn(
     best first, with ``k = min(top_k, N)``. When fewer than ``k`` valid
     items exist (tiny catalog, long history), the tail slots carry id ``-1``
     and the float32-min sentinel score — callers truncate at the first -1.
+
+    ``valid_mask``: optional (N,) bool — False rows are never recommended.
+    Real artifacts need this: the reference's own demo shard has more token
+    rows than mapped nids (225 vs 139), and an unmapped row has no id to
+    report.
     """
+    if valid_mask is not None:
+        valid_mask = jnp.asarray(valid_mask, bool)
 
     def recommend(user_params: Any, news_vecs: jnp.ndarray, history: jnp.ndarray):
         his_vecs = news_vecs[history]  # (B, H, D)
@@ -57,6 +65,8 @@ def build_recommend_fn(
         n = news_vecs.shape[0]
         # drop the pad slot, and (optionally) everything already clicked
         invalid = jnp.zeros((history.shape[0], n), bool).at[:, 0].set(True)
+        if valid_mask is not None:
+            invalid = invalid | ~valid_mask[None, :]
         if exclude_history:
             rows = jnp.arange(history.shape[0])[:, None]
             invalid = invalid.at[rows, history].set(True)

@@ -33,6 +33,15 @@ class SnapshotManager:
         self.manager.save(round_idx, args=ocp.args.StandardSave(state))
         self.manager.wait_until_finished()
 
+    def restore_raw(self, round_idx: int | None = None) -> Any:
+        """Restore WITHOUT a template: the saved pytree as host arrays, any
+        leading client dim intact. Serving uses this — it must not need the
+        training run's mesh (or even its device count) to read parameters."""
+        step = self.latest_round() if round_idx is None else round_idx
+        if step is None:
+            raise FileNotFoundError(f"no snapshot under {self.directory}")
+        return self.manager.restore(step, args=ocp.args.StandardRestore())
+
     def restore(self, state_template: Any, round_idx: int | None = None) -> Any:
         """Restore into the structure of ``state_template`` (shapes/dtypes)."""
         step = self.latest_round() if round_idx is None else round_idx

@@ -212,6 +212,13 @@ class Trainer:
             return encode_corpus_tokens(self.text_encoder, news_params, self.news_tokens)
         return encode_all_news(self.model, news_params, self.token_states)
 
+    def export_for_serving(self) -> tuple[Any, jnp.ndarray]:
+        """``(user_params, (N, D) news-vector table)`` of client 0 — the
+        handoff to :mod:`fedrec_tpu.serve` (after ``param_avg``/coordinator
+        aggregation all clients hold identical parameters)."""
+        user_params, news_params = self._client0_params()
+        return user_params, self._encode_corpus(news_params)
+
     def _feature_table(self) -> jnp.ndarray:
         if self.mode == "finetune":
             return self.news_tokens

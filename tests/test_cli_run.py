@@ -9,6 +9,7 @@ corpus path and the reference ``UserData/`` artifact layout.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -69,3 +70,44 @@ def test_run_cli_reference_artifacts(tmp_path):
         tmp_path,
     )
     assert "final:" in out
+
+
+def test_recommend_cli_after_training(tmp_path):
+    """Train -> serve round trip on the reference demo shard: the recommend
+    driver restores the snapshot the run driver wrote and emits valid
+    JSON-lines top-k recommendations for every known user. Training uses a
+    2-client mesh while serving runs on a single device — the restore is
+    template-free, so the snapshot's client dim must not matter."""
+    shard = "/root/reference/UserData"
+    if not os.path.isdir(shard):
+        pytest.skip("reference demo shard not present")
+    common = ["--set", "model.bert_hidden=32", "--set", "model.news_dim=32",
+              "--set", "model.num_heads=4", "--set", "model.head_dim=8",
+              "--set", "model.query_dim=16", "--set", "data.max_his_len=10"]
+    _run_cli(["1", "2", "1", "--strategy", "param_avg", "--clients", "2",
+              "--data-dir", shard, *common], tmp_path)
+    assert (tmp_path / "snapshots").exists()
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out_path = tmp_path / "recs.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.recommend",
+         "--data-dir", shard, "--snapshot-dir", str(tmp_path / "snapshots"),
+         "--top-k", "5", "--out", str(out_path), *common],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    import pickle
+    with open(Path(shard) / "bert_nid2index.pkl", "rb") as f:
+        nid2index = pickle.load(f)
+    lines = [json.loads(ln) for ln in out_path.read_text().splitlines()]
+    assert lines, "no recommendations written"
+    for rec in lines:
+        assert 0 < len(rec["news"]) <= 5
+        assert len(rec["news"]) == len(rec["scores"])
+        assert all(n in nid2index and nid2index[n] != 0 for n in rec["news"])
+        assert rec["scores"] == sorted(rec["scores"], reverse=True)

@@ -122,3 +122,15 @@ def test_recommend_tiny_catalog_clamps_and_marks_invalid(setup):
     assert set(ids[0][:2]) == {4, 5}
     assert np.all(ids[0][2:] == -1)
     assert np.all(scores[0][2:] <= np.finfo(np.float32).min)
+
+
+def test_recommend_valid_mask(setup):
+    """False rows in valid_mask are never recommended (unmapped-nid case)."""
+    cfg, model, params, news_vecs, history = setup
+    valid = np.zeros(news_vecs.shape[0], bool)
+    valid[:50] = True
+    ids, _ = build_recommend_fn(model, top_k=20, valid_mask=valid)(
+        params, news_vecs, history
+    )
+    ids = np.asarray(ids)
+    assert np.all((ids < 50) & (ids > 0))
