@@ -6,6 +6,7 @@ from fedrec_tpu.train.step import (
     build_news_update_step,
     build_param_sync,
     encode_all_news,
+    encode_all_news_sharded,
 )
 
 __all__ = [
@@ -16,6 +17,7 @@ __all__ = [
     "build_news_update_step",
     "build_param_sync",
     "encode_all_news",
+    "encode_all_news_sharded",
     "init_client_state",
     "stack_states",
 ]

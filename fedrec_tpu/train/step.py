@@ -270,6 +270,44 @@ def encode_all_news(
     return vecs.reshape(-1, vecs.shape[-1])[:n]
 
 
+def encode_all_news_sharded(
+    model: NewsRecommender,
+    news_params: Any,
+    token_states: jnp.ndarray,
+    mesh: Mesh,
+    chunk: int = 2048,
+) -> jnp.ndarray:
+    """Corpus encode sharded over EVERY mesh axis: each of the mesh's
+    ``mesh.size`` devices encodes ``N / mesh.size`` rows (a (clients, seq)
+    mesh shards over both axes jointly), and the result is logically the
+    full (N, D) table (XLA inserts the gather only where a consumer needs
+    it replicated).
+
+    On a pod this turns the per-round corpus refresh — the eval-path
+    bottleneck at MIND scale (65k news) — into ``1/mesh.size`` of the
+    single-chip wall time. Exact same math as :func:`encode_all_news`
+    (the per-shard body IS that function).
+    """
+    axes = tuple(mesh.axis_names)
+    n = token_states.shape[0]
+    pad = (-n) % mesh.size
+    padded = (
+        jnp.pad(token_states, ((0, pad), (0, 0), (0, 0))) if pad else token_states
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axes)),
+        out_specs=P(axes),
+        check_vma=False,
+    )
+    def enc(params, rows):
+        return encode_all_news(model, params, rows, chunk)
+
+    return enc(news_params, padded)[:n]
+
+
 # ------------------------------------------------------------- train steps
 def build_fed_train_step(
     model: NewsRecommender,

@@ -48,6 +48,7 @@ from fedrec_tpu.train.step import (
     build_news_update_step,
     build_param_sync,
     encode_all_news,
+    encode_all_news_sharded,
 )
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
@@ -199,10 +200,29 @@ class Trainer:
         if self.mode == "decoupled":
             self._refresh_table()
 
+    def _replicate_table(self, table: jnp.ndarray) -> jnp.ndarray:
+        """Pin a news-vector table to the one replicated layout the train
+        step expects (in_spec ``P()``). The decoupled round alternates table
+        sources (sharded refresh vs per-client update slice); without a
+        common layout each source would key its own compile of the step."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(table, NamedSharding(self.mesh, PartitionSpec()))
+
     def _refresh_table(self) -> jnp.ndarray:
         _, news_params = self._client0_params()
-        self._table = encode_all_news(self.model, news_params, self.token_states)
+        self._table = self._replicate_table(self._encode_states(news_params))
         return self._table
+
+    def _encode_states(self, news_params) -> jnp.ndarray:
+        """Cached-trunk corpus encode, sharded over all mesh devices when
+        there are several (per-round refresh is the eval-path bottleneck at
+        corpus scale)."""
+        if self.mesh.size > 1:
+            return encode_all_news_sharded(
+                self.model, news_params, self.token_states, self.mesh
+            )
+        return encode_all_news(self.model, news_params, self.token_states)
 
     def _encode_corpus(self, news_params) -> jnp.ndarray:
         """(N, D) news-vector table from client params, any text-encoder mode."""
@@ -210,7 +230,7 @@ class Trainer:
             from fedrec_tpu.train.step import encode_corpus_tokens
 
             return encode_corpus_tokens(self.text_encoder, news_params, self.news_tokens)
-        return encode_all_news(self.model, news_params, self.token_states)
+        return self._encode_states(news_params)
 
     def export_for_serving(self) -> tuple[Any, jnp.ndarray]:
         """``(user_params, (N, D) news-vector table)`` of client 0 — the
@@ -261,7 +281,9 @@ class Trainer:
                     overflows.append(metrics["unique_overflow"])
             if self.mode == "decoupled":
                 self.state, tables = self.news_update(self.state, self.token_states)
-                self._table = jax.tree_util.tree_map(lambda x: x[0], tables)
+                self._table = self._replicate_table(
+                    jax.tree_util.tree_map(lambda x: x[0], tables)
+                )
 
         if self.strategy.sync_params_every_round:
             self.state = self.param_sync(self.state, weights)

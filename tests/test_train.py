@@ -285,3 +285,42 @@ def test_unique_news_cap_exact_below_cap_and_flags_overflow():
     step_tiny = build_fed_train_step(model, cfg_tiny, strategy, mesh, mode="joint")
     _, m_tiny = step_tiny(stacked, batch, token_states)
     assert int(np.max(np.asarray(m_tiny["unique_overflow"]))) > 0
+
+
+def test_encode_all_news_sharded_matches_single():
+    """Mesh-sharded corpus encode == single-device encode, including the
+    pad-to-divisible path (N=101 not divisible by 8 devices)."""
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.parallel import client_mesh
+    from fedrec_tpu.train.state import init_client_state
+    from fedrec_tpu.train.step import encode_all_news, encode_all_news_sharded
+
+    cfg = ExperimentConfig()
+    cfg.model.bert_hidden = 32
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(7)
+    states = jnp.asarray(rng.standard_normal((101, 6, 32)).astype(np.float32))
+    p = init_client_state(model, cfg, jax.random.PRNGKey(0), 101, 6).news_params
+
+    single = encode_all_news(model, p, states)
+    # 1-D clients mesh AND a 2-D (clients, seq) mesh: rows shard over the
+    # PRODUCT of axes — no device may hold redundant work
+    from jax.sharding import Mesh
+
+    meshes = [
+        client_mesh(8),
+        Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("clients", "seq")),
+    ]
+    for mesh in meshes:
+        sharded = encode_all_news_sharded(model, p, states, mesh)
+        assert sharded.shape == single.shape == (101, 32)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(single), rtol=2e-5, atol=2e-6
+        )
