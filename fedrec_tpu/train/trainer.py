@@ -211,16 +211,20 @@ class Trainer:
 
     def _refresh_table(self) -> jnp.ndarray:
         _, news_params = self._client0_params()
-        self._table = self._replicate_table(self._encode_states(news_params))
+        self._table = self._encode_states(news_params)
         return self._table
 
     def _encode_states(self, news_params) -> jnp.ndarray:
         """Cached-trunk corpus encode, sharded over all mesh devices when
         there are several (per-round refresh is the eval-path bottleneck at
-        corpus scale)."""
+        corpus scale). The result is pinned replicated so every consumer —
+        train step (in_spec ``P()``), per-batch eval gathers, serving
+        export — pays the post-encode all-gather exactly once here."""
         if self.mesh.size > 1:
-            return encode_all_news_sharded(
-                self.model, news_params, self.token_states, self.mesh
+            return self._replicate_table(
+                encode_all_news_sharded(
+                    self.model, news_params, self.token_states, self.mesh
+                )
             )
         return encode_all_news(self.model, news_params, self.token_states)
 
