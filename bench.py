@@ -103,11 +103,13 @@ def _git_head() -> str:
 
 def _reexec(platform: str) -> None:
     """Re-exec the bench pinned to a platform, env hardened first."""
-    env = dict(os.environ)
-    env[_INNER] = platform
     if platform == "cpu":
-        env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
-        env["JAX_PLATFORMS"] = "cpu"
+        from fedrec_tpu.hostenv import cpu_host_env
+
+        env = cpu_host_env()
+    else:
+        env = dict(os.environ)
+    env[_INNER] = platform
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
@@ -218,6 +220,13 @@ def main() -> None:
         synchronized chain pays a fixed ~65 ms tunnel round-trip, so the
         per-step time is taken from the DIFFERENCE of a 2x-length and a
         1x-length chain, cancelling the constant.
+
+        NOTE: ``benchmarks/pallas_bench.py`` ``_time()`` implements the same
+        protocol for op-level chains (step_profile.py imports it from
+        there). Any change to the jitter-floor threshold or chain-growth
+        policy must be applied to BOTH, or the repo's perf numbers stop
+        being comparable; merging them is deferred until a live chip can
+        re-validate the merged timer.
         """
         the_step = the_step or step
         feats = token_states if feats is None else feats
@@ -301,10 +310,27 @@ def main() -> None:
                 out["mfu_estimate"] = round(flops / dt / peak, 4)
                 out["flops_per_step"] = flops
                 break
-        # 8-client grad-avg equivalent: one lockstep B=512 step on this chip
-        B8 = 8 * B
-        dt8 = measure(B8, iters=20)
-        out["clients8_samples_per_sec"] = round(B8 / dt8, 2)
+
+        def stamp_and_cache():
+            # primary evidence; stamped so a later cached read-back carries
+            # its real provenance (wall time + code revision measured).
+            # Called after EVERY metric lands so a bonus-metric failure (or
+            # a tunnel wedge mid-bonus) can never discard what's measured.
+            out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            out["measured_commit"] = _git_head()
+            cache_path.write_text(json.dumps(out, indent=2))
+
+        stamp_and_cache()  # the B=64 primary is in the bank
+
+        # 8-client grad-avg equivalent: one lockstep B=512 step on this chip.
+        # A bonus metric: its jitter failure must not discard the primary.
+        try:
+            B8 = 8 * B
+            dt8 = measure(B8, iters=20)
+            out["clients8_samples_per_sec"] = round(B8 / dt8, 2)
+            stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] clients8 bonus metric failed: {e}\n")
 
         # unique-news cap: same math (dedup is exact; overflow checked in
         # the step's own metric), fewer dead text-tower slots. B=64 random
@@ -327,14 +353,9 @@ def main() -> None:
                 raise RuntimeError("cap 2560 overflowed on the bench batch")
             dt_cap = measure(B, iters=50, the_step=step_cap)
             out["capped2560_samples_per_sec"] = round(B / dt_cap, 2)
+            stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] capped bonus metric failed: {e}\n")
-
-        # primary evidence; stamped so a later cached read-back carries its
-        # real provenance (wall time + code revision measured)
-        out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        out["measured_commit"] = _git_head()
-        cache_path.write_text(json.dumps(out, indent=2))
 
         # decoupled (reference-parity) mode: the text tower leaves the step —
         # news vecs come from a precomputed (N, D) table gather; this is the
@@ -350,7 +371,7 @@ def main() -> None:
             )
             dt_d = measure(B, iters=100, the_step=step_d, feats=table)
             out["decoupled_samples_per_sec"] = round(B / dt_d, 2)
-            cache_path.write_text(json.dumps(out, indent=2))
+            stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] decoupled bonus metric failed: {e}\n")
 

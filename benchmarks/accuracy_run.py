@@ -483,17 +483,49 @@ def main() -> int:
     args = p.parse_args()
 
     if args.all:
-        env_fed = dict(os.environ)
-        env_fed.pop("PALLAS_AXON_POOL_IPS", None)
-        env_fed["JAX_PLATFORMS"] = "cpu"
-        env_fed["XLA_FLAGS"] = (
-            env_fed.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
+        from fedrec_tpu.hostenv import cpu_host_env
+
+        # the central leg wants the real chip, but launching it with the
+        # ambient env while the tunnel is wedged hangs forever (the wedge
+        # passes a device listing and stalls at first compile) — probe with
+        # a real compile first, exactly like bench.py, and fall back to the
+        # CPU-scaled corpus when the chip can't actually run ops
+        import bench
+
+        if bench._probe_accelerator():
+            env_central = dict(os.environ)
+        else:
+            print("[accuracy] accelerator unusable; central leg on CPU "
+                  "(FEDREC_ACC_CPU scale)", file=sys.stderr)
+            env_central = cpu_host_env()
+            env_central["FEDREC_ACC_CPU"] = "1"
+
+        env_fed = cpu_host_env(8)
         me = str(HERE / "accuracy_run.py")
+        central_cmd = [
+            sys.executable, me, "--leg", "central", "--rounds", str(args.rounds)
+        ]
+        # the probe only closes the wedged-at-launch case; a POST-probe wedge
+        # would hang the leg at its first compile, so the accelerator attempt
+        # also runs under a watchdog with the same CPU fallback (per-round
+        # persist means a mid-run wedge still leaves a PARTIAL curve)
+        try:
+            rc = subprocess.run(
+                central_cmd, env=env_central, cwd=REPO, timeout=2400
+            ).returncode
+        except subprocess.TimeoutExpired:
+            print("[accuracy] central leg timed out (tunnel wedge?); "
+                  "retrying on CPU", file=sys.stderr)
+            rc = 1
+        if rc != 0 and "FEDREC_ACC_CPU" not in env_central:
+            env_cpu = cpu_host_env()
+            env_cpu["FEDREC_ACC_CPU"] = "1"
+            rc = subprocess.run(
+                central_cmd, env=env_cpu, cwd=REPO, timeout=7200
+            ).returncode
+        if rc != 0:
+            return rc
         for cmd, env in (
-            ([sys.executable, me, "--leg", "central", "--rounds", str(args.rounds)],
-             dict(os.environ)),
             ([sys.executable, me, "--leg", "fed", "--rounds", str(args.fed_rounds)],
              env_fed),
             ([sys.executable, me, "--leg", "adressa",

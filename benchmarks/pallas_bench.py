@@ -42,6 +42,11 @@ def _time(fn, *args, iters: int = 30) -> float:
     runs INSIDE one jitted ``lax.scan`` with a scalar data dependency
     between iterations, synchronization is a host readback, and the fixed
     tunnel round-trip cancels by differencing a 2x-length chain.
+
+    NOTE: ``bench.py`` ``measure()`` implements the same protocol for
+    whole-train-step chains. Any change to the differencing policy must be
+    applied to BOTH (see the note there); merging is deferred until a live
+    chip can re-validate a shared timer.
     """
     import jax
     import jax.numpy as jnp

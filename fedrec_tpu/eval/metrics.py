@@ -134,6 +134,19 @@ def evaluation_split(
 # --------------------------------------------------------------------------
 
 
+def _metrics_from_rank(rank: jnp.ndarray) -> dict:
+    """MRR/NDCG@5/NDCG@10 from the positive's 1-based rank — the shared
+    closed forms (single positive, ideal DCG = 1). AUC differs between the
+    fixed-C and masked-pool layouts, so each caller supplies its own."""
+    mrr = 1.0 / rank
+    ndcg = 1.0 / jnp.log2(rank + 1.0)
+    return {
+        "mrr": mrr,
+        "ndcg5": jnp.where(rank <= 5, ndcg, 0.0),
+        "ndcg10": jnp.where(rank <= 10, ndcg, 0.0),
+    }
+
+
 def ranking_metrics_batch(scores: jnp.ndarray, positive_index: int = 0) -> dict:
     """Per-impression AUC/MRR/NDCG@5/NDCG@10 for fixed-size impressions, on device.
 
@@ -159,12 +172,7 @@ def ranking_metrics_batch(scores: jnp.ndarray, positive_index: int = 0) -> dict:
         [scores[:, :positive_index], scores[:, positive_index + 1 :]], axis=1
     )
     rank = 1.0 + jnp.sum(others >= pos, axis=1).astype(jnp.float32)
-    auc = (c - rank) / (c - 1)
-    mrr = 1.0 / rank
-    ndcg = 1.0 / jnp.log2(rank + 1.0)
-    ndcg5 = jnp.where(rank <= 5, ndcg, 0.0)
-    ndcg10 = jnp.where(rank <= 10, ndcg, 0.0)
-    return {"auc": auc, "mrr": mrr, "ndcg5": ndcg5, "ndcg10": ndcg10}
+    return {"auc": (c - rank) / (c - 1), **_metrics_from_rank(rank)}
 
 
 def full_pool_metrics_batch(
@@ -198,8 +206,4 @@ def full_pool_metrics_batch(
     beaten_by = jnp.sum((neg >= pos) * mask, axis=1)
     rank = 1.0 + beaten_by
     auc = jnp.where(n_neg > 0, (n_neg - beaten_by) / jnp.maximum(n_neg, 1.0), 0.0)
-    mrr = 1.0 / rank
-    ndcg = 1.0 / jnp.log2(rank + 1.0)
-    ndcg5 = jnp.where(rank <= 5, ndcg, 0.0)
-    ndcg10 = jnp.where(rank <= 10, ndcg, 0.0)
-    return {"auc": auc, "mrr": mrr, "ndcg5": ndcg5, "ndcg10": ndcg10}
+    return {"auc": auc, **_metrics_from_rank(rank)}

@@ -1,0 +1,47 @@
+"""CPU-host environment hardening — the one copy of the axon recipe.
+
+The axon TPU plugin's sitecustomize hook (triggered by
+``PALLAS_AXON_POOL_IPS``) can wedge ANY jax backend init in a process, even
+under ``JAX_PLATFORMS=cpu`` — so every subprocess that must run on the CPU
+(fake-mesh tests, dryruns, bench fallbacks, accuracy legs) needs the same
+env surgery applied before the interpreter starts. This module is the single
+source of that recipe; it imports nothing but the stdlib so it is safe to
+use from entry points that must not touch jax before re-exec
+(``__graft_entry__``, ``bench.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count="
+
+
+def cpu_host_env(
+    n_devices: int | None = None, base: dict | None = None
+) -> dict[str, str]:
+    """A copy of ``base`` (default ``os.environ``) hardened for a CPU-host
+    jax run: axon hook removed, platform pinned to cpu, and — when
+    ``n_devices`` is given — exactly one fake-device-count flag in
+    ``XLA_FLAGS`` (other inherited flags are preserved)."""
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        kept = [
+            t for t in env.get("XLA_FLAGS", "").split()
+            if not t.startswith(_DEVCOUNT_FLAG)
+        ]
+        env["XLA_FLAGS"] = " ".join(kept + [f"{_DEVCOUNT_FLAG}{n_devices}"])
+    return env
+
+
+def fake_device_count(env: dict | None = None) -> int | None:
+    """The configured fake-CPU device count, or None when absent/invalid."""
+    flags = (os.environ if env is None else env).get("XLA_FLAGS", "")
+    if _DEVCOUNT_FLAG not in flags:
+        return None
+    try:
+        return int(flags.split(_DEVCOUNT_FLAG, 1)[1].split()[0])
+    except (IndexError, ValueError):
+        return None

@@ -567,6 +567,12 @@ def build_fed_train_step(
                 batch["candidates"], batch["history"],
                 cfg.data.unique_news_cap, table.shape[0],
             )
+            if n_seq > 1:
+                # each seq shard dedups its own history slice, so overflow
+                # is per-shard; without this sum the P(clients) out-spec
+                # (check_vma=False) would report only seq-shard 0's flag and
+                # silently swallow corruption on the others
+                flag = lax.psum(flag, seq_ax)
             metrics["unique_overflow"] = lax.psum(flag, axis_name=axis)
         return new_state, metrics
 

@@ -184,6 +184,7 @@ class Trainer:
         self.state = jax.tree_util.tree_map(
             lambda x: jax.device_put(jnp.asarray(x), sharding), state
         )
+        self._table = None  # params changed; a cached decoupled table is stale
 
     def set_global_params(self, user_params: Any, news_params: Any) -> None:
         """Adopt externally-aggregated parameters on every local client.
@@ -234,6 +235,12 @@ class Trainer:
             from fedrec_tpu.train.step import encode_corpus_tokens
 
             return encode_corpus_tokens(self.text_encoder, news_params, self.news_tokens)
+        if self.mode == "decoupled" and self._table is not None:
+            # the round loop (news_update / _refresh_table / set_global_params)
+            # just rebuilt this table from the same client-0 params — a second
+            # full-corpus encode per eval round would double the exact cost
+            # the sharded encode exists to cut
+            return self._table
         return self._encode_states(news_params)
 
     def export_for_serving(self) -> tuple[Any, jnp.ndarray]:

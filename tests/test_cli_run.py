@@ -17,16 +17,13 @@ from pathlib import Path
 
 import pytest
 
+from fedrec_tpu.hostenv import cpu_host_env
+
 REPO = str(Path(__file__).resolve().parents[1])
 
 
 def _run_cli(args: list[str], tmp_path, timeout: int = 300) -> str:
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
-    ).strip()
+    env = cpu_host_env(2)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
         [sys.executable, "-m", "fedrec_tpu.cli.run", *args],
@@ -88,9 +85,7 @@ def test_recommend_cli_after_training(tmp_path):
               "--data-dir", shard, *common], tmp_path)
     assert (tmp_path / "snapshots").exists()
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = cpu_host_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out_path = tmp_path / "recs.jsonl"
     proc = subprocess.run(

@@ -7,9 +7,14 @@ import socket
 import subprocess
 import sys
 import textwrap
+from pathlib import Path
 
 import numpy as np
 import pytest
+
+from fedrec_tpu.hostenv import cpu_host_env
+
+REPO = str(Path(__file__).resolve().parents[1])
 
 from fedrec_tpu.config import ExperimentConfig
 from fedrec_tpu.data import make_synthetic_mind
@@ -278,11 +283,9 @@ def test_coordinator_two_process_cpu(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # single device per process
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env = cpu_host_env()
+    env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process  # single device per process
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid)],
@@ -344,11 +347,9 @@ def test_coordinator_survives_peer_death(tmp_path):
     port = _free_port()
     script = tmp_path / "fault_worker.py"
     script.write_text(FAULT_WORKER)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env = cpu_host_env()
+    env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     rounds = 4
     procs = [
         subprocess.Popen(
@@ -395,11 +396,9 @@ COORD_CLI = textwrap.dedent(
 
 def _run_coord_cli(tmp_path, script, rounds, dirs, tag):
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env = cpu_host_env()
+    env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid), str(dirs[pid]), str(rounds)],
@@ -446,11 +445,9 @@ def test_coordinator_cli_two_process(tmp_path):
     port = _free_port()
     script = tmp_path / "coord_cli.py"
     script.write_text(COORD_CLI)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env = cpu_host_env()
+    env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid), str(tmp_path / f"s{pid}")],
@@ -534,11 +531,9 @@ def test_coordinator_aggregate_weight_by_samples(tmp_path):
     port = _free_port()
     script = tmp_path / "weighted_worker.py"
     script.write_text(WEIGHTED_WORKER)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env = cpu_host_env()
+    env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid)],
