@@ -1,0 +1,86 @@
+"""Tests for the observability utilities (`fedrec_tpu.utils`, `hostenv`)."""
+
+from __future__ import annotations
+
+import io
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from fedrec_tpu.hostenv import cpu_host_env, fake_device_count
+from fedrec_tpu.utils.logging import MetricLogger
+from fedrec_tpu.utils.profiling import profile_if
+
+
+def test_metric_logger_schema():
+    """One JSON record per log call: step + elapsed + the 6-metric schema
+    (reference ``client.py:182-189``), device scalars coerced to float."""
+    buf = io.StringIO()
+    logger = MetricLogger(use_wandb=False, stream=buf)
+    logger.log(0, {
+        "training_loss": jnp.float32(1.5), "valid_loss": 1.2,
+        "valid_auc": np.float64(0.7), "valid_mrr": 0.3,
+        "val_ndcg@5": 0.35, "val_ndcg@10": 0.42,
+    })
+    logger.log(1, {"training_loss": 1.4})
+    logger.finish()
+
+    lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert [r["step"] for r in lines] == [0, 1]
+    first = lines[0]
+    assert first["training_loss"] == 1.5          # device scalar -> float
+    assert isinstance(first["valid_auc"], float)
+    assert set(first) >= {"step", "elapsed_sec", "training_loss", "valid_loss",
+                          "valid_auc", "valid_mrr", "val_ndcg@5", "val_ndcg@10"}
+    json.dumps(lines)  # everything serializable
+
+
+def test_metric_logger_wandb_degrades_to_stdout(monkeypatch):
+    """No wandb auth in this environment: use_wandb=True must not raise and
+    must keep stdout logging working (the reference instead hardcoded an API
+    key, ``client.py:214``)."""
+    monkeypatch.delenv("WANDB_API_KEY", raising=False)
+    monkeypatch.setenv("WANDB_MODE", "disabled")
+    buf = io.StringIO()
+    logger = MetricLogger(use_wandb=True, stream=buf)
+    logger.log(0, {"training_loss": 1.0})
+    logger.finish()
+    assert json.loads(buf.getvalue().splitlines()[0])["training_loss"] == 1.0
+
+
+def test_profile_if_writes_trace(tmp_path):
+    """enabled=True wraps the region in a jax.profiler trace and leaves a
+    TensorBoard-compatible artifact; enabled=False is a no-op."""
+    with profile_if(False, str(tmp_path / "off")):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    assert not (tmp_path / "off").exists()
+
+    logdir = tmp_path / "on"
+    with profile_if(True, str(logdir)):
+        (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+    traces = list(logdir.rglob("*.xplane.pb"))
+    assert traces, f"no trace written under {logdir}"
+
+
+def test_cpu_host_env_recipe():
+    base = {
+        "PALLAS_AXON_POOL_IPS": "1.2.3.4",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=2",
+        "OTHER": "kept",
+    }
+    env = cpu_host_env(8, base=base)
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["OTHER"] == "kept"
+    # exactly one devcount flag, other XLA flags preserved
+    assert env["XLA_FLAGS"].count("device_count") == 1
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    assert fake_device_count(env) == 8
+    # n_devices=None leaves XLA_FLAGS untouched
+    env2 = cpu_host_env(base=base)
+    assert env2["XLA_FLAGS"] == base["XLA_FLAGS"]
+    assert fake_device_count({"XLA_FLAGS": "--nope"}) is None
+    # pure function: the base mapping is never mutated
+    assert base["JAX_PLATFORMS"] == "axon" and "PALLAS_AXON_POOL_IPS" in base
