@@ -183,6 +183,8 @@ def main(argv: list[str] | None = None) -> int:
         round_idx += 1
 
     print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
+    if trainer.snapshots is not None:
+        trainer.snapshots.wait()  # settle async saves before any exit path
     trainer.logger.finish()  # before finalize: os._exit skips teardown
     rt.finalize(0)  # no-op unless the world broke mid-run (then exits here)
     return 0

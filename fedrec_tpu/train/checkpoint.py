@@ -26,25 +26,41 @@ class SnapshotManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
-    def latest_round(self) -> int | None:
-        return self.manager.latest_step()
-
-    def save(self, round_idx: int, state: Any) -> None:
-        self.manager.save(round_idx, args=ocp.args.StandardSave(state))
+    def _settled_step(self, round_idx: int | None) -> int | None:
+        """The one reader-side settle point: waits out any in-flight async
+        save, then resolves ``None`` to the latest step."""
         self.manager.wait_until_finished()
+        return self.manager.latest_step() if round_idx is None else round_idx
+
+    def latest_round(self) -> int | None:
+        return self._settled_step(None)
+
+    def save(self, round_idx: int, state: Any, wait: bool = False) -> None:
+        """Persist the full state pytree for ``round_idx``.
+
+        Async by default: orbax snapshots device buffers synchronously (the
+        values are consistent) but performs the serialization/IO in the
+        background, overlapping with the next round's compute instead of
+        stalling the step stream. Readers (``latest_round``/``restore``) and
+        ``close`` settle in-flight saves first, so no torn snapshot is ever
+        observable. ``wait=True`` restores the blocking behavior.
+        """
+        self.manager.save(round_idx, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
 
     def restore_raw(self, round_idx: int | None = None) -> Any:
         """Restore WITHOUT a template: the saved pytree as host arrays, any
         leading client dim intact. Serving uses this — it must not need the
         training run's mesh (or even its device count) to read parameters."""
-        step = self.latest_round() if round_idx is None else round_idx
+        step = self._settled_step(round_idx)
         if step is None:
             raise FileNotFoundError(f"no snapshot under {self.directory}")
         return self.manager.restore(step, args=ocp.args.StandardRestore())
 
     def restore(self, state_template: Any, round_idx: int | None = None) -> Any:
         """Restore into the structure of ``state_template`` (shapes/dtypes)."""
-        step = self.latest_round() if round_idx is None else round_idx
+        step = self._settled_step(round_idx)
         if step is None:
             raise FileNotFoundError(f"no snapshot under {self.directory}")
         abstract = jax.tree_util.tree_map(
@@ -52,5 +68,9 @@ class SnapshotManager:
         )
         return self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
 
+    def wait(self) -> None:
+        """Settle in-flight async saves (call before process exit)."""
+        self.manager.wait_until_finished()
+
     def close(self) -> None:
-        self.manager.close()
+        self.manager.close()  # orbax settles in-flight saves itself

@@ -458,5 +458,7 @@ class Trainer:
                     or round_idx == cfg.fed.rounds - 1
                 ):
                     self.snapshots.save(round_idx, self.state)
+        if self.snapshots is not None:
+            self.snapshots.wait()  # settle async saves before handing back
         self.logger.finish()
         return history
