@@ -159,26 +159,31 @@ def main(argv: list[str] | None = None) -> int:
             elif local_snap is not None:
                 from flax import serialization
 
+                from fedrec_tpu.train.checkpoint import (
+                    atomic_write_bytes,
+                    coordinator_globals,
+                )
+
                 snapshot_dir.mkdir(parents=True, exist_ok=True)
-                local_snap.write_bytes(
+                # atomic writes: a concurrently-running fedrec-recommend
+                # must never read a torn snapshot
+                atomic_write_bytes(
+                    local_snap,
                     serialization.to_bytes(
                         {"state": trainer.state, "round": round_idx}
-                    )
+                    ),
                 )
                 if rt.is_server:
-                    (snapshot_dir / f"global_round_{round_idx}.msgpack").write_bytes(
+                    atomic_write_bytes(
+                        snapshot_dir / f"global_round_{round_idx}.msgpack",
                         serialization.to_bytes(
                             {"user": u, "news": n, "round": round_idx}
-                        )
+                        ),
                     )
                     # retention: mirror orbax's max_to_keep=3 — the reference
                     # leaves received_model_{i}.pt files piling up forever
                     # (server.py:27)
-                    kept = sorted(
-                        snapshot_dir.glob("global_round_*.msgpack"),
-                        key=lambda p: int(p.stem.rsplit("_", 1)[1]),
-                    )
-                    for old in kept[:-3]:
+                    for old in coordinator_globals(snapshot_dir)[:-3]:
                         old.unlink(missing_ok=True)
         round_idx += 1
 

@@ -74,19 +74,58 @@ def main(argv: list[str] | None = None) -> int:
     cfg.apply_overrides(args.overrides)
     snap_dir = args.snapshot_dir or cfg.train.snapshot_dir
 
-    # template-free restore: serving must not depend on the training run's
-    # client count or mesh — any (N_clients, ...) snapshot serves anywhere
-    # (after param_avg/coordinator aggregation all clients are identical;
-    # client 0 is the convention, matching Trainer._client0_params)
+    # two snapshot formats can coexist in one directory: orbax trees
+    # (fedrec-run) and the coordinator deployment's flax-msgpack globals
+    # ({user, news, round}, no client dim). Serve whichever recorded the
+    # LATER round — a stale orbax run must not shadow a newer coordinator
+    # model just because of format precedence.
+    from fedrec_tpu.train.checkpoint import coordinator_globals, global_round_of
+
     snapshots = SnapshotManager(snap_dir)
-    if snapshots.latest_round() is None:
-        print(f"[recommend] no snapshot under {snap_dir} — train first "
-              "(fedrec-run ...) or pass --snapshot-dir", file=sys.stderr)
+    orbax_round = snapshots.latest_round()
+    globals_ = coordinator_globals(snap_dir)
+    global_round = global_round_of(globals_[-1]) if globals_ else None
+    if orbax_round is not None and global_round is not None:
+        print(f"[recommend] both orbax (round {orbax_round}) and coordinator "
+              f"globals (round {global_round}) in {snap_dir}; serving the "
+              "newer round", file=sys.stderr)
+
+    if orbax_round is not None and (global_round is None or orbax_round >= global_round):
+        # template-free restore: serving must not depend on the training
+        # run's client count or mesh — any (N_clients, ...) snapshot serves
+        # anywhere (after param_avg/coordinator aggregation all clients are
+        # identical; client 0 is the convention, Trainer._client0_params)
+        raw = snapshots.restore_raw()
+        snapshots.close()
+        client0 = jax.tree_util.tree_map(lambda x: jnp.asarray(x[0]), raw)
+        user_params, news_params = client0["user_params"], client0["news_params"]
+    elif globals_:
+        snapshots.close()
+        from flax import serialization
+
+        # newest first; retry older files if a concurrent retention pass
+        # unlinks one between the glob and the read (writes are atomic)
+        raw = None
+        for cand in reversed(globals_):
+            try:
+                raw = serialization.msgpack_restore(cand.read_bytes())
+                break
+            except FileNotFoundError:
+                continue
+        if raw is None:
+            print(f"[recommend] coordinator globals vanished under {snap_dir}; "
+                  "retry", file=sys.stderr)
+            return 2
+        user_params = jax.tree_util.tree_map(jnp.asarray, raw["user"])
+        news_params = jax.tree_util.tree_map(jnp.asarray, raw["news"])
+        print(f"[recommend] serving coordinator global round {raw['round']}",
+              file=sys.stderr)
+    else:
+        snapshots.close()
+        print(f"[recommend] no orbax snapshot or coordinator global under "
+              f"{snap_dir} — train first (fedrec-run / fedrec-coordinator) "
+              "or pass --snapshot-dir", file=sys.stderr)
         return 2
-    raw = snapshots.restore_raw()
-    snapshots.close()
-    client0 = jax.tree_util.tree_map(lambda x: jnp.asarray(x[0]), raw)
-    user_params, news_params = client0["user_params"], client0["news_params"]
 
     data = load_mind_artifacts(args.data_dir)
     model = NewsRecommender(cfg.model)

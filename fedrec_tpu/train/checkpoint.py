@@ -11,6 +11,7 @@ Adam moments reset on resume; ledger).
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
 from typing import Any
 
@@ -74,3 +75,26 @@ class SnapshotManager:
 
     def close(self) -> None:
         self.manager.close()  # orbax settles in-flight saves itself
+
+
+def coordinator_globals(directory: str | Path) -> list[Path]:
+    """The coordinator deployment's global-model snapshots
+    (``global_round_N.msgpack``, flax-serialized ``{user, news, round}``),
+    oldest to newest. The single source of the filename contract — the
+    coordinator's writer/retention and the serving CLI's reader both use it.
+    """
+    return sorted(
+        Path(directory).glob("global_round_*.msgpack"),
+        key=lambda p: int(p.stem.rsplit("_", 1)[1]),
+    )
+
+
+def global_round_of(path: Path) -> int:
+    return int(path.stem.rsplit("_", 1)[1])
+
+
+def atomic_write_bytes(path: Path, blob: bytes) -> None:
+    """Write-then-rename so concurrent readers never see a torn file."""
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_bytes(blob)
+    os.replace(tmp, path)

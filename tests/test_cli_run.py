@@ -106,3 +106,57 @@ def test_recommend_cli_after_training(tmp_path):
         assert len(rec["news"]) == len(rec["scores"])
         assert all(n in nid2index and nid2index[n] != 0 for n in rec["news"])
         assert rec["scores"] == sorted(rec["scores"], reverse=True)
+
+
+def test_recommend_cli_from_coordinator_global(tmp_path):
+    """The multi-process coordinator persists globals as flax msgpack
+    ({user, news, round}, no client dim) rather than orbax; the recommend
+    driver must serve from that format too — the distributed-training ->
+    serving journey."""
+    shard = "/root/reference/UserData"
+    if not os.path.isdir(shard):
+        pytest.skip("reference demo shard not present")
+
+    import jax
+    from flax import serialization
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import load_mind_artifacts
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.train.state import init_client_state
+
+    cfg = ExperimentConfig()
+    cfg.apply_overrides([
+        "model.bert_hidden=32", "model.news_dim=32", "model.num_heads=4",
+        "model.head_dim=8", "model.query_dim=16", "data.max_his_len=10",
+    ])
+    data = load_mind_artifacts(shard)
+    state = init_client_state(
+        NewsRecommender(cfg.model), cfg, jax.random.PRNGKey(1),
+        data.num_news, data.title_len,
+    )
+    snap_dir = tmp_path / "snapshots"
+    snap_dir.mkdir()
+    # two rounds present: the loader must pick the LATEST
+    for r in (0, 1):
+        blob = serialization.to_bytes(
+            {"user": state.user_params, "news": state.news_params, "round": r}
+        )
+        (snap_dir / f"global_round_{r}.msgpack").write_bytes(blob)
+
+    env = cpu_host_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out_path = tmp_path / "recs.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.recommend",
+         "--data-dir", shard, "--snapshot-dir", str(snap_dir),
+         "--top-k", "4", "--out", str(out_path),
+         "--set", "model.bert_hidden=32", "--set", "model.news_dim=32",
+         "--set", "model.num_heads=4", "--set", "model.head_dim=8",
+         "--set", "model.query_dim=16", "--set", "data.max_his_len=10"],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "serving coordinator global round 1" in proc.stderr
+    lines = [json.loads(ln) for ln in out_path.read_text().splitlines()]
+    assert lines and all(0 < len(r["news"]) <= 4 for r in lines)
