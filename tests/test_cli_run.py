@@ -160,3 +160,20 @@ def test_recommend_cli_from_coordinator_global(tmp_path):
     assert "serving coordinator global round 1" in proc.stderr
     lines = [json.loads(ln) for ln in out_path.read_text().splitlines()]
     assert lines and all(0 < len(r["news"]) <= 4 for r in lines)
+
+
+def test_run_cli_dp_epsilon(tmp_path):
+    """--dp-epsilon wires calibration into the run: sigma is derived from
+    (eps, delta) and reported, and training still completes."""
+    out = _run_cli(
+        ["1", "16", "1", "--strategy", "grad_avg", "--clients", "2",
+         "--synthetic", "--token-states", str(tmp_path / "none.npy"),
+         "--dp-epsilon", "10",
+         "--set", "data.max_his_len=10",
+         "--set", "model.bert_hidden=32", "--set", "model.news_dim=32",
+         "--set", "model.num_heads=4", "--set", "model.head_dim=8",
+         "--set", "model.query_dim=16"],
+        tmp_path,
+    )
+    assert "DP enabled: eps=10" in out and "sigma=" in out
+    assert "final:" in out
