@@ -204,12 +204,20 @@ def leg_fed(rounds: int) -> None:
         # embedding grads at epoch end (reference model.py:66-90)
         "decoupled_1client": ("local", 1, None, "table"),
         "param_avg_8": ("param_avg", 8, None, "head"),
+        # FedAvgM (server momentum over round deltas, Reddi et al. 2021) —
+        # beyond-parity: the reference only has the plain mean
+        "param_avg_8_fedavgm": ("param_avg+fedavgm", 8, None, "head"),
         "grad_avg_8": ("grad_avg", 8, None, "head"),
         # two epsilons -> a privacy-utility tradeoff, not one crushed point
         "param_avg_8_dp50": ("param_avg", 8, 50.0, "head"),
         "param_avg_8_dp10": ("param_avg", 8, 10.0, "head"),
     }.items():
         cfg = ExperimentConfig()
+        if strategy.endswith("+fedavgm"):
+            strategy = strategy.split("+")[0]
+            cfg.fed.server_opt = "sgd"
+            cfg.fed.server_lr = 1.0
+            cfg.fed.server_momentum = 0.9
         cfg.model.text_encoder_mode = mode
         cfg.model.news_dim = 64
         cfg.model.num_heads = 8

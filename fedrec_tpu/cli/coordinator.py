@@ -108,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
         cfg.train.snapshot_dir = ""
     trainer = Trainer(cfg, data, token_states)
 
+    server_optimizer = None
     if rt.num_processes > 1:
         from flax import serialization
 
@@ -121,6 +122,34 @@ def main(argv: list[str] | None = None) -> int:
                 f"[coordinator] process {rt.process_id} resumed local state "
                 f"at round {trainer.start_round - 1}"
             )
+        if cfg.fed.server_opt != "none":
+            # cross-host FedOpt is hub-and-spoke: ONLY the server holds and
+            # steps the optimizer (the FedOpt paper's topology); clients
+            # adopt the plain mean this round and receive the server's
+            # post-opt global at the next round's fan-out. Optimizer state
+            # therefore never needs to agree across hosts — a client
+            # resuming from a stale snapshot cannot desync it. The per-host
+            # trainer must not also step its own server optimizer on the
+            # in-process mean (double application).
+            trainer.server_opt = None
+            if rt.is_server:
+                from fedrec_tpu.fed.strategies import ServerOptimizer
+
+                server_optimizer = ServerOptimizer(
+                    cfg.fed.server_opt, cfg.fed.server_lr, cfg.fed.server_momentum
+                )
+                opt_snap = snapshot_dir / "server_opt_state.msgpack"
+                if cfg.train.resume and opt_snap.exists():
+                    loaded_round = server_optimizer.load_state(
+                        opt_snap.read_bytes(), trainer._client0_params()
+                    )
+                    if loaded_round != trainer.start_round - 1:
+                        print(
+                            f"[coordinator] server_opt sidecar is from round "
+                            f"{loaded_round}, local snapshot from round "
+                            f"{trainer.start_round - 1} — momentum may be "
+                            "skewed for the first resumed round"
+                        )
 
     round_idx = trainer.start_round
     while True:
@@ -135,6 +164,7 @@ def main(argv: list[str] | None = None) -> int:
         u0, n0 = trainer._client0_params()
         u, n = rt.sync_from_server((u0, n0))
         trainer.set_global_params(u, n)
+        round_start_global = (u, n)
 
         result = None
         if trains:
@@ -147,6 +177,10 @@ def main(argv: list[str] | None = None) -> int:
         u0, n0 = trainer._client0_params()
         w = float(len(data.train_samples)) if cfg.fed.weight_by_samples else 1.0
         u, n = rt.aggregate((u0, n0), participated=trains, weight=w)
+        if server_optimizer is not None:
+            # deterministic on identical inputs, so every process steps the
+            # same optimizer state locally — no extra bytes cross the wire
+            u, n = server_optimizer.step(round_start_global, (u, n))
         trainer.set_global_params(u, n)
 
         if result is not None:
@@ -155,7 +189,18 @@ def main(argv: list[str] | None = None) -> int:
             trainer.logger.log(round_idx, log)
         if (round_idx + 1) % cfg.train.save_every == 0:
             if trainer.snapshots is not None:
-                trainer.snapshots.save(round_idx, trainer.state)
+                # blocking under FedOpt so the sidecar never outruns the
+                # orbax snapshot it pairs with (see Trainer.run)
+                trainer.snapshots.save(
+                    round_idx, trainer.state, wait=trainer.server_opt is not None
+                )
+                if trainer.server_opt is not None:
+                    from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                    atomic_write_bytes(
+                        trainer.snapshots.directory / "server_opt_state.msgpack",
+                        trainer.server_opt.state_bytes(round_idx),
+                    )
             elif local_snap is not None:
                 from flax import serialization
 
@@ -173,6 +218,12 @@ def main(argv: list[str] | None = None) -> int:
                         {"state": trainer.state, "round": round_idx}
                     ),
                 )
+                if server_optimizer is not None:
+                    # server-only state (hub-and-spoke FedOpt), round-tagged
+                    atomic_write_bytes(
+                        snapshot_dir / "server_opt_state.msgpack",
+                        server_optimizer.state_bytes(round_idx),
+                    )
                 if rt.is_server:
                     atomic_write_bytes(
                         snapshot_dir / f"global_round_{round_idx}.msgpack",

@@ -117,6 +117,13 @@ class FedConfig:
     seq_shards: int = 1
     seq_axis: str = "seq"
     seq_impl: str = "ring"             # "ring" | "ulysses"
+    # server-side optimization over round deltas (FedOpt, Reddi et al. 2021):
+    # "none" adopts the client mean (plain FedAvg = reference behavior);
+    # "sgd" with server_momentum>0 is FedAvgM; "adam" is FedAdam. Applies to
+    # param_avg and coordinator strategies.
+    server_opt: str = "none"           # "none" | "sgd" | "adam"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
 
 
 @dataclass

@@ -123,3 +123,101 @@ def weighted_param_avg(params: Any, weight: jnp.ndarray, axis: str) -> Any:
         ),
         params,
     )
+
+
+class ServerOptimizer:
+    """Server-side optimization over round deltas (FedOpt family).
+
+    Plain FedAvg (the reference's only aggregation,
+    ``Parameter_Averaging_main.py:144-148``) ADOPTS the client mean each
+    round. The FedOpt view (Reddi et al. 2021 "Adaptive Federated
+    Optimization") instead treats ``global - mean`` as a pseudo-gradient and
+    feeds it to a server optimizer, giving momentum/adaptivity across
+    rounds without touching client code:
+
+        delta  = global - mean            # pseudo-gradient
+        global = global + server_opt(delta)
+
+    ``kind='sgd'`` with ``lr=1, momentum=0`` reproduces FedAvg exactly;
+    ``momentum>0`` is FedAvgM; ``kind='adam'`` is FedAdam. State (momentum /
+    adaptivity buffers) lives host-side on the server: in the coordinator
+    deployment every process applies the same deterministic update to the
+    same aggregate, so no extra bytes cross the wire.
+
+    Pure numpy by design: the server step is a tiny host-side round-boundary
+    computation (~2M params), and keeping it off the devices means zero extra
+    device programs racing the round's collectives (on single-core XLA:CPU
+    rigs that race can starve the 8-way rendezvous into its termination
+    deadline; on TPU it is simply wasted dispatch).
+    """
+
+    def __init__(self, kind: str = "sgd", lr: float = 1.0, momentum: float = 0.0):
+        if kind not in ("sgd", "adam"):
+            raise ValueError(f"unknown server optimizer {kind!r}; 'sgd' | 'adam'")
+        self.kind, self.lr, self.momentum = kind, float(lr), float(momentum)
+        self.b1, self.b2, self.eps = 0.9, 0.999, 1e-8  # optax.adam defaults
+        self._state: dict | None = None
+
+    def _tmap(self, fn, *trees):
+        import numpy as onp
+
+        return jax.tree_util.tree_map(
+            lambda *xs: fn(*[onp.asarray(x) for x in xs]), *trees
+        )
+
+    def _init_state(self, params: Any) -> dict:
+        import numpy as onp
+
+        zeros = self._tmap(lambda p: onp.zeros_like(p), params)
+        if self.kind == "sgd":
+            return {"buf": zeros, "t": 0}
+        return {"m": zeros, "v": self._tmap(lambda p: onp.zeros_like(p), params), "t": 0}
+
+    def step(self, global_params: Any, mean_params: Any) -> Any:
+        """One server update on host arrays: returns the new global params."""
+        import numpy as onp
+
+        delta = self._tmap(lambda g, m: g - m, global_params, mean_params)
+        if self._state is None:
+            self._state = self._init_state(global_params)
+        st = self._state
+        st["t"] += 1
+        if self.kind == "sgd":
+            if self.momentum:
+                st["buf"] = self._tmap(
+                    lambda b, d: self.momentum * b + d, st["buf"], delta
+                )
+                upd = st["buf"]
+            else:
+                upd = delta
+            return self._tmap(lambda p, u: p - self.lr * u, global_params, upd)
+        # adam (bias-corrected, optax semantics)
+        st["m"] = self._tmap(lambda m, d: self.b1 * m + (1 - self.b1) * d, st["m"], delta)
+        st["v"] = self._tmap(lambda v, d: self.b2 * v + (1 - self.b2) * d * d, st["v"], delta)
+        t = st["t"]
+        c1, c2 = 1 - self.b1**t, 1 - self.b2**t
+        return self._tmap(
+            lambda p, m, v: p - self.lr * (m / c1) / (onp.sqrt(v / c2) + self.eps),
+            global_params, st["m"], st["v"],
+        )
+
+    # -- persistence: the buffers live host-side, outside the orbax client
+    #    snapshot, so resume needs a sidecar for bit-identical FedOpt runs.
+    #    The sidecar is round-tagged so a loader can detect state that does
+    #    not match the snapshot it resumes from.
+    def state_bytes(self, round_idx: int = -1) -> bytes:
+        from flax import serialization
+
+        return serialization.to_bytes({"opt": self._state, "round": round_idx})
+
+    def load_state(self, blob: bytes, params_template: Any) -> int:
+        """Restore buffers; returns the round the sidecar was written at."""
+        from flax import serialization
+
+        if self._state is None:
+            self._state = self._init_state(params_template)
+        restored = serialization.from_bytes(
+            {"opt": self._state, "round": 0}, blob
+        )
+        self._state = restored["opt"]
+        return int(restored["round"])

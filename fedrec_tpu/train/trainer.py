@@ -80,6 +80,13 @@ class Trainer:
         self.data = data
         self.model = NewsRecommender(cfg.model)
         self.strategy = get_strategy(cfg.fed.strategy)
+        self.server_opt = None
+        if cfg.fed.server_opt != "none" and self.strategy.sync_params_every_round:
+            from fedrec_tpu.fed.strategies import ServerOptimizer
+
+            self.server_opt = ServerOptimizer(
+                cfg.fed.server_opt, cfg.fed.server_lr, cfg.fed.server_momentum
+            )
         self.mesh = fed_mesh(cfg)
         self.mode = {"table": "decoupled", "head": "joint", "finetune": "finetune"}.get(
             cfg.model.text_encoder_mode, "joint"
@@ -162,6 +169,21 @@ class Trainer:
                 self.state = self.snapshots.restore(self.state)
                 self.start_round = int(self.snapshots.latest_round()) + 1
                 print(f"[trainer] resumed from snapshot at round {self.start_round - 1}")
+                if self.server_opt is not None:
+                    # FedOpt buffers live host-side; restore the sidecar so
+                    # a resumed run is bit-identical to an uninterrupted one
+                    sidecar = self.snapshots.directory / "server_opt_state.msgpack"
+                    if sidecar.exists():
+                        loaded_round = self.server_opt.load_state(
+                            sidecar.read_bytes(), self._client0_params()
+                        )
+                        if loaded_round != self.start_round - 1:
+                            print(
+                                f"[trainer] server_opt sidecar from round "
+                                f"{loaded_round} != snapshot round "
+                                f"{self.start_round - 1}; momentum may be "
+                                "skewed for the first resumed round"
+                            )
 
         self.logger = MetricLogger(
             use_wandb=cfg.train.wandb,
@@ -169,6 +191,7 @@ class Trainer:
             run_name=cfg.train.run_name,
         )
         self._table: jnp.ndarray | None = None  # decoupled-mode news-vec table
+        self._adopt_fn = None  # lazy compiled set_global_params program
 
     # ------------------------------------------------------------------
     def _client0_params(self) -> tuple[Any, Any]:
@@ -192,11 +215,37 @@ class Trainer:
         Used by the coordinator deployment: the server's weight fan-out
         (reference ``server.py:76-77`` / ``client.py:261-264``) lands here.
         """
-        n = self.cfg.fed.num_clients
-        bcast = lambda x: jnp.broadcast_to(x, (n,) + x.shape)  # noqa: E731
-        self.state = self.state.replace(
-            user_params=jax.tree_util.tree_map(bcast, user_params),
-            news_params=jax.tree_util.tree_map(bcast, news_params),
+        # ONE compiled program replaces a per-leaf broadcast+device_put storm:
+        # each mesh shard swaps its param slices for the (replicated) new
+        # globals, so the state keeps its client sharding and the round
+        # boundary issues a single dispatch (the transfer storm both wastes
+        # TPU dispatch and, on single-core XLA:CPU rigs, can starve the next
+        # round's collective rendezvous into its termination deadline)
+        if self._adopt_fn is None:
+            from functools import partial
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            axis = self.cfg.fed.mesh_axis
+
+            @partial(
+                shard_map,
+                mesh=self.mesh,
+                in_specs=(P(axis), P(), P()),
+                out_specs=P(axis),
+                check_vma=False,
+            )
+            def adopt(stacked, u, n):
+                local = jax.tree_util.tree_map(lambda x: x[0], stacked)
+                local = local.replace(user_params=u, news_params=n)
+                return jax.tree_util.tree_map(lambda x: x[None], local)
+
+            self._adopt_fn = jax.jit(adopt, donate_argnums=(0,))
+        self.state = self._adopt_fn(
+            self.state,
+            jax.tree_util.tree_map(jnp.asarray, user_params),
+            jax.tree_util.tree_map(jnp.asarray, news_params),
         )
         if self.mode == "decoupled":
             self._refresh_table()
@@ -269,6 +318,19 @@ class Trainer:
             mask_rng, cfg.fed.num_clients, cfg.fed.participation
         )
 
+        round_start_global = None
+        if self.server_opt is not None:
+            # all clients hold identical params at round entry (initial
+            # replication / previous sync); client 0 IS the global model.
+            # Materialized to host: the server step is a round-boundary op,
+            # and the readback doubles as a barrier that keeps the device
+            # program queue shallow (async dispatch of per-round reshard +
+            # broadcast programs can otherwise pile up far enough to trip
+            # XLA:CPU's 40 s collective-rendezvous termination deadline)
+            round_start_global = jax.tree_util.tree_map(
+                np.asarray, self._client0_params()
+            )
+
         losses = []
         overflows = []  # device arrays; read once at round end (no per-step sync)
         for local_epoch in range(cfg.fed.local_epochs):
@@ -298,7 +360,27 @@ class Trainer:
 
         if self.strategy.sync_params_every_round:
             self.state = self.param_sync(self.state, weights)
-            if self.mode == "decoupled":
+            if self.server_opt is not None:
+                # FedOpt: the weighted mean is a proposal, not the new model —
+                # the server optimizer steps the global from round_start
+                # toward it (set_global_params rebroadcasts to all clients
+                # and refreshes the decoupled table).
+                # Drain the round's step backlog FIRST via a data dependency:
+                # the client-0 slice below is a cross-device gather, and
+                # dispatching it behind a full epoch of queued steps leaves
+                # its rendezvous open for the whole backlog — on a time-
+                # sliced XLA:CPU rig that trips the 40 s collective
+                # termination deadline (observed; steps drain incrementally
+                # through per-value readbacks everywhere else).
+                if losses:
+                    jax.block_until_ready(losses[-1])
+                mean = jax.tree_util.tree_map(np.asarray, self._client0_params())
+                new_u, new_n = self.server_opt.step(round_start_global, mean)
+                self.set_global_params(
+                    jax.tree_util.tree_map(jnp.asarray, new_u),
+                    jax.tree_util.tree_map(jnp.asarray, new_n),
+                )
+            elif self.mode == "decoupled":
                 self._refresh_table()
 
         if overflows:
@@ -457,7 +539,20 @@ class Trainer:
                     (round_idx + 1) % cfg.train.save_every == 0
                     or round_idx == cfg.fed.rounds - 1
                 ):
-                    self.snapshots.save(round_idx, self.state)
+                    # blocking save under FedOpt: the sidecar must never be
+                    # newer than the orbax snapshot it pairs with (a crash
+                    # between an async save and the sidecar write would
+                    # resume round-r momentum against round r-k params)
+                    self.snapshots.save(
+                        round_idx, self.state, wait=self.server_opt is not None
+                    )
+                    if self.server_opt is not None:
+                        from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                        atomic_write_bytes(
+                            self.snapshots.directory / "server_opt_state.msgpack",
+                            self.server_opt.state_bytes(round_idx),
+                        )
         if self.snapshots is not None:
             self.snapshots.wait()  # settle async saves before handing back
         self.logger.finish()

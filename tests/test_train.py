@@ -324,3 +324,108 @@ def test_encode_all_news_sharded_matches_single():
         np.testing.assert_allclose(
             np.asarray(sharded), np.asarray(single), rtol=2e-5, atol=2e-6
         )
+
+
+def _server_opt_trainer(tmp_path, server_opt, lr=1.0, momentum=0.0, rounds=3,
+                        snapshot=False):
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import make_synthetic_mind
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.model.text_encoder_mode = "head"
+    cfg.data.max_his_len = 8
+    cfg.data.max_title_len = 8
+    cfg.data.batch_size = 8
+    cfg.fed.num_clients = 4
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = rounds
+    cfg.fed.server_opt = server_opt
+    cfg.fed.server_lr = lr
+    cfg.fed.server_momentum = momentum
+    cfg.train.snapshot_dir = str(tmp_path) if snapshot else ""
+    cfg.train.resume = snapshot
+    cfg.train.save_every = 1
+    data = make_synthetic_mind(
+        num_news=64, num_train=96, num_valid=0, title_len=8,
+        his_len_range=(2, 8), seed=3,
+    )
+    states = np.random.default_rng(1).standard_normal(
+        (64, 8, 48)
+    ).astype(np.float32)
+    return Trainer(cfg, data, states), cfg
+
+
+def _flat_params(trainer):
+    import jax
+
+    u, n = trainer._client0_params()
+    return np.concatenate(
+        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves((u, n))]
+    )
+
+
+def test_server_opt_sgd_neutral_equals_fedavg(tmp_path):
+    """FedOpt with sgd(lr=1, momentum=0) IS plain FedAvg: identical params."""
+    t_plain, _ = _server_opt_trainer(tmp_path / "plain", "none")
+    t_neutral, _ = _server_opt_trainer(tmp_path / "neutral", "sgd", lr=1.0)
+    for r in range(3):
+        t_plain.train_round(r)
+        t_neutral.train_round(r)
+    # g + (m - g) per round is not bitwise m in float32; absolute floor
+    # needed for near-zero params (same rationale as the coordinator test)
+    np.testing.assert_allclose(
+        _flat_params(t_plain), _flat_params(t_neutral), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_server_opt_momentum_math():
+    """ServerOptimizer reproduces hand-rolled FedAvgM over two rounds."""
+    from fedrec_tpu.fed.strategies import ServerOptimizer
+
+    rng = np.random.default_rng(0)
+    g = {"w": jnp.asarray(rng.standard_normal(5).astype(np.float32))}
+    m1 = {"w": jnp.asarray(rng.standard_normal(5).astype(np.float32))}
+    m2 = {"w": jnp.asarray(rng.standard_normal(5).astype(np.float32))}
+    lr, beta = 0.5, 0.9
+
+    opt = ServerOptimizer("sgd", lr=lr, momentum=beta)
+    g1 = opt.step(g, m1)
+    g2 = opt.step(g1, m2)
+
+    # optax sgd-with-momentum: buf = beta*buf + delta; p -= lr*buf
+    d1 = np.asarray(g["w"]) - np.asarray(m1["w"])
+    buf = d1
+    want1 = np.asarray(g["w"]) - lr * buf
+    np.testing.assert_allclose(np.asarray(g1["w"]), want1, rtol=1e-6)
+    d2 = want1 - np.asarray(m2["w"])
+    buf = beta * buf + d2
+    want2 = want1 - lr * buf
+    np.testing.assert_allclose(np.asarray(g2["w"]), want2, rtol=1e-6)
+
+
+def test_server_opt_resume_bit_identical(tmp_path):
+    """FedAvgM momentum buffers survive resume via the sidecar: interrupted
+    + resumed == straight through."""
+    t_a, _ = _server_opt_trainer(
+        tmp_path / "a", "sgd", lr=0.7, momentum=0.9, rounds=4, snapshot=True
+    )
+    t_a.run()
+
+    t_b, _ = _server_opt_trainer(
+        tmp_path / "b", "sgd", lr=0.7, momentum=0.9, rounds=2, snapshot=True
+    )
+    t_b.run()
+    t_b2, _ = _server_opt_trainer(
+        tmp_path / "b", "sgd", lr=0.7, momentum=0.9, rounds=4, snapshot=True
+    )
+    assert t_b2.start_round == 2
+    t_b2.run()
+    np.testing.assert_allclose(
+        _flat_params(t_a), _flat_params(t_b2), rtol=1e-6, atol=1e-7
+    )

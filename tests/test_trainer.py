@@ -289,7 +289,7 @@ def test_coordinator_two_process_cpu(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid)],
-            env=env, cwd="/root/repo",
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(2)
@@ -354,7 +354,7 @@ def test_coordinator_survives_peer_death(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid), str(rounds)],
-            env=env, cwd="/root/repo",
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(2)
@@ -379,6 +379,7 @@ COORD_CLI = textwrap.dedent(
     from fedrec_tpu.cli.coordinator import main
     port, pid, snap = sys.argv[1], sys.argv[2], sys.argv[3]
     rounds = sys.argv[4] if len(sys.argv) > 4 else "2"
+    extra = sys.argv[5:]  # additional --set overrides
     code = main([
         rounds, "8", "1",
         "--coordinator", f"127.0.0.1:{port}",
@@ -388,21 +389,23 @@ COORD_CLI = textwrap.dedent(
         "--set", "data.max_title_len=12", "--set", "model.news_dim=32",
         "--set", "model.num_heads=4", "--set", "model.head_dim=8",
         "--set", "model.query_dim=16", "--set", f"train.snapshot_dir={snap}",
+        *extra,
     ])
     sys.exit(code)
     """
 )
 
 
-def _run_coord_cli(tmp_path, script, rounds, dirs, tag):
+def _run_coord_cli(tmp_path, script, rounds, dirs, tag, extra=()):
     port = _free_port()
     env = cpu_host_env()
     env.pop("XLA_FLAGS", None)  # drop any fake-device-count: 1 device/process
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(port), str(pid), str(dirs[pid]), str(rounds)],
-            env=env, cwd="/root/repo",
+            [sys.executable, str(script), str(port), str(pid), str(dirs[pid]),
+             str(rounds), *extra],
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(2)
@@ -451,7 +454,7 @@ def test_coordinator_cli_two_process(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid), str(tmp_path / f"s{pid}")],
-            env=env, cwd="/root/repo",
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(2)
@@ -537,7 +540,7 @@ def test_coordinator_aggregate_weight_by_samples(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid)],
-            env=env, cwd="/root/repo",
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(2)
@@ -550,3 +553,56 @@ def test_coordinator_aggregate_weight_by_samples(tmp_path):
             pytest.fail("weighted aggregate worker timed out")
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WEIGHTED_OK {pid}" in out
+
+
+def test_coordinator_cli_server_opt(tmp_path):
+    """Cross-host FedOpt in the coordinator: a neutral server optimizer
+    (sgd lr=1, momentum=0) reproduces plain aggregation bit-for-bit, and
+    FedAvgM (momentum=0.9) actually changes the global — proving the
+    optimizer sits in the aggregation path on every process identically."""
+    script = tmp_path / "coord_cli.py"
+    script.write_text(COORD_CLI)
+
+    plain = [tmp_path / "p0", tmp_path / "p1"]
+    _run_coord_cli(tmp_path, script, 2, plain, "plain")
+
+    neutral = [tmp_path / "n0", tmp_path / "n1"]
+    _run_coord_cli(
+        tmp_path, script, 2, neutral, "neutral",
+        extra=["--set", "fed.server_opt=sgd", "--set", "fed.server_lr=1.0",
+               "--set", "fed.server_momentum=0.0"],
+    )
+    from flax import serialization
+
+    def flat_global(path):
+        raw = serialization.msgpack_restore(path.read_bytes())
+        import jax
+
+        return np.concatenate([
+            np.ravel(np.asarray(x))
+            for x in jax.tree_util.tree_leaves((raw["user"], raw["news"]))
+        ])
+
+    # g + (m - g) is not bitwise m in float32: the subtraction leaves an
+    # absolute error ~eps*|g| that is RELATIVELY huge on near-zero params,
+    # so the tolerance needs an absolute floor
+    np.testing.assert_allclose(
+        flat_global(plain[0] / "global_round_1.msgpack"),
+        flat_global(neutral[0] / "global_round_1.msgpack"),
+        rtol=1e-4, atol=1e-5,
+    )
+
+    fedavgm = [tmp_path / "m0", tmp_path / "m1"]
+    _run_coord_cli(
+        tmp_path, script, 2, fedavgm, "fedavgm",
+        extra=["--set", "fed.server_opt=sgd", "--set", "fed.server_lr=0.7",
+               "--set", "fed.server_momentum=0.9"],
+    )
+    assert not np.allclose(
+        flat_global(fedavgm[0] / "global_round_1.msgpack"),
+        flat_global(plain[0] / "global_round_1.msgpack"),
+        rtol=1e-4,
+    )
+    # hub-and-spoke: optimizer state lives ONLY on the server (process 0)
+    assert (fedavgm[0] / "server_opt_state.msgpack").exists()
+    assert not (fedavgm[1] / "server_opt_state.msgpack").exists()
