@@ -71,6 +71,9 @@ def main() -> int:
             "param_avg": round(2 * trainable / mb, 3),
             # hub-and-spoke: server fan-out + client fan-in, params once each
             "coordinator": round(2 * trainable / mb, 3),
+            # fed.dcn_compress=int8: client->server int8 (+1 f32 scale/leaf),
+            # fan-out full precision
+            "coordinator_int8": round((1 + 0.25) * trainable / mb, 3),
             # DDP parity: one grad payload every step
             "grad_avg": round(steps * trainable / mb, 3),
         },
@@ -78,6 +81,7 @@ def main() -> int:
         "reduction_vs_reference": {
             "param_avg": round(REFERENCE_MB / (2 * trainable / mb), 1),
             "coordinator": round(REFERENCE_MB / (2 * trainable / mb), 1),
+            "coordinator_int8": round(REFERENCE_MB / (1.25 * trainable / mb), 1),
         },
         "note": (
             "payload bytes of the actual flagship param trees; the frozen "

@@ -61,8 +61,6 @@ def main(argv: list[str] | None = None) -> int:
     from fedrec_tpu.privacy import calibrate_from_config
     from fedrec_tpu.train.trainer import Trainer
 
-    rt = CoordinatorRuntime(collective_timeout_s=args.collective_timeout or None)
-
     cfg = ExperimentConfig()
     cfg.fed.rounds = args.total_epochs
     cfg.data.batch_size = args.batch_size
@@ -73,6 +71,11 @@ def main(argv: list[str] | None = None) -> int:
     cfg.fed.local_epochs = args.local_epochs
     cfg.fed.num_clients = args.clients or len(jax.local_devices())
     cfg.apply_overrides(args.overrides)
+
+    rt = CoordinatorRuntime(
+        collective_timeout_s=args.collective_timeout or None,
+        compress=cfg.fed.dcn_compress,
+    )
 
     if args.synthetic:
         data = make_synthetic_mind(

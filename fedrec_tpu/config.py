@@ -124,6 +124,10 @@ class FedConfig:
     server_opt: str = "none"           # "none" | "sgd" | "adam"
     server_lr: float = 1.0
     server_momentum: float = 0.9
+    # coordinator-deployment client->server payload compression over DCN:
+    # "int8" = symmetric per-tensor quantization (4x the wire, zero-mean
+    # rounding noise on the round mean; fan-out stays full precision)
+    dcn_compress: str = "none"         # "none" | "int8"
 
 
 @dataclass

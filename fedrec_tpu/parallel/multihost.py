@@ -77,17 +77,80 @@ def broadcast_round_index(round_idx: int) -> int:
     return int(v)
 
 
-def aggregate_from_hosts(params: Any, weight: float = 1.0) -> Any:
+def validate_compress(compress: str) -> str:
+    """Fail FAST on a bad mode: raised lazily inside the aggregation
+    collective, a typo would be misread by the watchdog as a peer failure
+    and silently degrade every host to standalone training."""
+    if compress not in ("none", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}; 'none' | 'int8'")
+    return compress
+
+
+def quantize_leaf(p: Any) -> tuple[np.ndarray, np.float32]:
+    """Symmetric per-tensor int8 quantization: ``p ~= q * scale``.
+
+    Max-abs scaling to 127 levels; an all-zero tensor gets scale 0 (its
+    dequantization is exactly zero). Worst-case element error is scale/2 =
+    max|p|/254 — ~0.2% of the tensor's dynamic range.
+    """
+    p = np.asarray(p, np.float32)
+    amax = float(np.max(np.abs(p))) if p.size else 0.0
+    scale = np.float32(amax / 127.0)
+    if scale == 0.0:
+        return np.zeros(p.shape, np.int8), scale
+    q = np.clip(np.rint(p / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_weighted_mean(
+    gathered_q: np.ndarray, gathered_scales: np.ndarray, weights: np.ndarray
+) -> np.ndarray:
+    """(P, ...) int8 contributions + (P,) scales + (P,) weights -> weighted
+    mean ``sum_i w_i * q_i * s_i / sum_i w_i`` (caller guards total > 0)."""
+    coeff = (weights * gathered_scales / np.sum(weights)).astype(np.float32)
+    return np.einsum("p,p...->...", coeff, gathered_q.astype(np.float32))
+
+
+def aggregate_from_hosts(params: Any, weight: float = 1.0, compress: str = "none") -> Any:
     """Participation-weighted FedAvg across processes.
 
     Each process contributes its local parameter pytree with ``weight``
     (0 = this client sat the round out). Every process receives the
     aggregate — the allgather-based replacement for the server's
     TCP-gather + key-wise mean (``server.py:37-55,102``).
+
+    ``compress='int8'`` quantizes the client->server payload (symmetric
+    per-tensor int8 + one f32 scale), cutting the gather traffic 4x on top
+    of the trainable-towers-only design. The server->client fan-out
+    (:func:`broadcast_params`) stays full precision — quantizing the global
+    model would bias every client's training, while quantizing the per-round
+    CONTRIBUTIONS only adds zero-mean rounding noise to the mean.
     """
+    validate_compress(compress)
+    w_arr = np.asarray(weight, np.float32)
+    if compress == "int8":
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        pairs = [quantize_leaf(p) for p in flat]
+        q = jax.tree_util.tree_unflatten(treedef, [x[0] for x in pairs])
+        scales = jax.tree_util.tree_unflatten(treedef, [x[1] for x in pairs])
+        # ONE collective for payload + scales + weight: fewer DCN round
+        # trips, and no window where a peer death strands the runtime
+        # between matched gathers
+        gathered_q, gathered_s, weights = multihost_utils.process_allgather(
+            (q, scales, w_arr)
+        )
+        total = float(np.sum(weights))
+        if total == 0.0:
+            return params  # nobody reported; keep local (no NaNs)
+        return jax.tree_util.tree_map(
+            lambda gq, gs: jnp.asarray(
+                dequantize_weighted_mean(np.asarray(gq), np.asarray(gs), np.asarray(weights))
+            ),
+            gathered_q,
+            gathered_s,
+        )
     weighted = jax.tree_util.tree_map(lambda p: np.asarray(p) * weight, params)
-    gathered = multihost_utils.process_allgather(weighted)  # leading axis = process
-    weights = multihost_utils.process_allgather(np.asarray(weight, np.float32))
+    gathered, weights = multihost_utils.process_allgather((weighted, w_arr))
     total = float(np.sum(weights))
     if total == 0.0:
         return params  # nobody reported; keep local (no NaNs)
@@ -113,10 +176,15 @@ class CoordinatorRuntime:
     they are weight-0 participation in :meth:`aggregate`.
     """
 
-    def __init__(self, collective_timeout_s: float | None = None):
+    def __init__(
+        self,
+        collective_timeout_s: float | None = None,
+        compress: str = "none",
+    ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
         self.collective_timeout_s = collective_timeout_s
+        self.compress = validate_compress(compress)
         self.degraded = False
 
     @property
@@ -187,7 +255,7 @@ class CoordinatorRuntime:
             return params
         w = float(weight) if participated else 0.0
         return self._collective(
-            lambda: aggregate_from_hosts(params, w),
+            lambda: aggregate_from_hosts(params, w, compress=self.compress),
             lambda: params,
         )
 
