@@ -26,7 +26,12 @@ REPO = HERE.parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
-REFERENCE_MB = 268.0  # Final_Report.pdf §VII.b, per client per round
+# Final_Report.pdf §VII.b: ~268 MB client->server state_dict upload per
+# round; the server broadcast fans the same bytes back (server.py:76-77),
+# so a full round moves ~2x that per client. All figures below count BOTH
+# directions on both sides, so the reduction factors compare like with like.
+REFERENCE_UP_MB = 268.0
+REFERENCE_ROUND_MB = 2 * REFERENCE_UP_MB
 
 
 def tree_bytes(tree) -> int:
@@ -38,6 +43,20 @@ def tree_bytes(tree) -> int:
 
 
 def main() -> int:
+    import os
+    import subprocess
+
+    from fedrec_tpu.hostenv import cpu_host_env
+
+    # self-harden: this is a host-side byte count — it must not touch (or
+    # wedge on) the axon TPU tunnel; the axon hook can wedge backend init
+    # even under JAX_PLATFORMS=cpu. Re-exec once under the CPU recipe.
+    if os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get("JAX_PLATFORMS") != "cpu":
+        env = cpu_host_env()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env=env
+        ).returncode
+
     import jax
 
     from fedrec_tpu.config import ExperimentConfig
@@ -61,11 +80,12 @@ def main() -> int:
     mb = 1024 * 1024
     out = {
         "metric": "comm_bytes_per_client_per_round",
-        "unit": "MB",
+        "unit": "MB (both directions)",
         "trainable_params_mb": round(trainable / mb, 3),
         "user_tower_mb": round(user_b / mb, 3),
         "text_head_mb": round(news_b / mb, 3),
-        "reference_mb": REFERENCE_MB,
+        "reference_up_mb": REFERENCE_UP_MB,
+        "reference_round_mb": REFERENCE_ROUND_MB,
         "strategies": {
             # FedAvg: one param payload per round (each direction)
             "param_avg": round(2 * trainable / mb, 3),
@@ -78,16 +98,18 @@ def main() -> int:
             "grad_avg": round(steps * trainable / mb, 3),
         },
         "grad_avg_steps_per_round": steps,
+        # both-direction / both-direction — like for like
         "reduction_vs_reference": {
-            "param_avg": round(REFERENCE_MB / (2 * trainable / mb), 1),
-            "coordinator": round(REFERENCE_MB / (2 * trainable / mb), 1),
-            "coordinator_int8": round(REFERENCE_MB / (1.25 * trainable / mb), 1),
+            "param_avg": round(REFERENCE_ROUND_MB / (2 * trainable / mb), 1),
+            "coordinator": round(REFERENCE_ROUND_MB / (2 * trainable / mb), 1),
+            "coordinator_int8": round(REFERENCE_ROUND_MB / (1.25 * trainable / mb), 1),
         },
         "note": (
-            "payload bytes of the actual flagship param trees; the frozen "
-            "DistilBERT trunk (the bulk of the reference's 268 MB) never "
-            "crosses the wire here. grad_avg trades round payload for "
-            "per-step sync, riding ICI instead of EC2 TCP."
+            "payload bytes of the actual flagship param trees, both "
+            "directions on both sides; the frozen DistilBERT trunk (the "
+            "bulk of the reference's 268 MB per direction) never crosses "
+            "the wire here. grad_avg trades round payload for per-step "
+            "sync, riding ICI instead of EC2 TCP."
         ),
     }
     (HERE / "comm_cost.json").write_text(json.dumps(out, indent=2))

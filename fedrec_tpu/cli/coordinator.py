@@ -181,8 +181,9 @@ def main(argv: list[str] | None = None) -> int:
         w = float(len(data.train_samples)) if cfg.fed.weight_by_samples else 1.0
         u, n = rt.aggregate((u0, n0), participated=trains, weight=w)
         if server_optimizer is not None:
-            # deterministic on identical inputs, so every process steps the
-            # same optimizer state locally — no extra bytes cross the wire
+            # server-only (hub-and-spoke): clients adopt the plain mean this
+            # round and receive the server's post-opt global at the next
+            # round's fan-out
             u, n = server_optimizer.step(round_start_global, (u, n))
         trainer.set_global_params(u, n)
 

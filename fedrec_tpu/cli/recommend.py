@@ -76,21 +76,34 @@ def main(argv: list[str] | None = None) -> int:
 
     # two snapshot formats can coexist in one directory: orbax trees
     # (fedrec-run) and the coordinator deployment's flax-msgpack globals
-    # ({user, news, round}, no client dim). Serve whichever recorded the
-    # LATER round — a stale orbax run must not shadow a newer coordinator
-    # model just because of format precedence.
-    from fedrec_tpu.train.checkpoint import coordinator_globals, global_round_of
+    # ({user, news, round}, no client dim). Serve whichever was WRITTEN
+    # more recently — round counters are per-run and say nothing about
+    # recency across unrelated runs (a 50-round fedrec-run must not shadow
+    # a later 20-round coordinator deployment), so the tie-break is the
+    # artifacts' own mtimes.
+    from fedrec_tpu.train.checkpoint import coordinator_globals
 
     snapshots = SnapshotManager(snap_dir)
     orbax_round = snapshots.latest_round()
     globals_ = coordinator_globals(snap_dir)
-    global_round = global_round_of(globals_[-1]) if globals_ else None
-    if orbax_round is not None and global_round is not None:
-        print(f"[recommend] both orbax (round {orbax_round}) and coordinator "
-              f"globals (round {global_round}) in {snap_dir}; serving the "
-              "newer round", file=sys.stderr)
 
-    if orbax_round is not None and (global_round is None or orbax_round >= global_round):
+    def _mtime(path: Path) -> float:
+        try:
+            return path.stat().st_mtime
+        except OSError:
+            return 0.0
+
+    orbax_mtime = (
+        _mtime(Path(snap_dir) / str(orbax_round)) if orbax_round is not None else 0.0
+    )
+    global_mtime = _mtime(globals_[-1]) if globals_ else 0.0
+    if orbax_round is not None and globals_:
+        newer = "orbax" if orbax_mtime >= global_mtime else "coordinator"
+        print(f"[recommend] both orbax (round {orbax_round}) and coordinator "
+              f"globals in {snap_dir}; serving the most recently written "
+              f"({newer})", file=sys.stderr)
+
+    if orbax_round is not None and (not globals_ or orbax_mtime >= global_mtime):
         # template-free restore: serving must not depend on the training
         # run's client count or mesh — any (N_clients, ...) snapshot serves
         # anywhere (after param_avg/coordinator aggregation all clients are

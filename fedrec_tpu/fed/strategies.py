@@ -140,9 +140,10 @@ class ServerOptimizer:
 
     ``kind='sgd'`` with ``lr=1, momentum=0`` reproduces FedAvg exactly;
     ``momentum>0`` is FedAvgM; ``kind='adam'`` is FedAdam. State (momentum /
-    adaptivity buffers) lives host-side on the server: in the coordinator
-    deployment every process applies the same deterministic update to the
-    same aggregate, so no extra bytes cross the wire.
+    adaptivity buffers) lives host-side on the SERVER ONLY: in the
+    coordinator deployment clients adopt the plain mean and receive the
+    server's post-opt global at the next round's fan-out, so client hosts
+    never hold (and cannot desync) optimizer state.
 
     Pure numpy by design: the server step is a tiny host-side round-boundary
     computation (~2M params), and keeping it off the devices means zero extra

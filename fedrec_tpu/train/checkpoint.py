@@ -82,15 +82,22 @@ def coordinator_globals(directory: str | Path) -> list[Path]:
     (``global_round_N.msgpack``, flax-serialized ``{user, news, round}``),
     oldest to newest. The single source of the filename contract — the
     coordinator's writer/retention and the serving CLI's reader both use it.
+    Files whose suffix is not an integer (operator backups like
+    ``global_round_19_backup.msgpack``) are ignored, not crashed on.
     """
-    return sorted(
-        Path(directory).glob("global_round_*.msgpack"),
-        key=lambda p: int(p.stem.rsplit("_", 1)[1]),
-    )
+    out = []
+    for p in Path(directory).glob("global_round_*.msgpack"):
+        r = global_round_of(p)
+        if r is not None:
+            out.append((r, p))
+    return [p for _, p in sorted(out)]
 
 
-def global_round_of(path: Path) -> int:
-    return int(path.stem.rsplit("_", 1)[1])
+def global_round_of(path: Path) -> int | None:
+    try:
+        return int(path.stem.rsplit("_", 1)[1])
+    except ValueError:
+        return None
 
 
 def atomic_write_bytes(path: Path, blob: bytes) -> None:

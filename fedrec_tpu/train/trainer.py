@@ -173,6 +173,13 @@ class Trainer:
                     # FedOpt buffers live host-side; restore the sidecar so
                     # a resumed run is bit-identical to an uninterrupted one
                     sidecar = self.snapshots.directory / "server_opt_state.msgpack"
+                    if not sidecar.exists():
+                        print(
+                            "[trainer] WARNING: resuming a fed.server_opt run "
+                            f"without {sidecar.name} — momentum/adaptivity "
+                            "buffers restart from zero, so the resumed "
+                            "trajectory will differ from an uninterrupted one"
+                        )
                     if sidecar.exists():
                         loaded_round = self.server_opt.load_state(
                             sidecar.read_bytes(), self._client0_params()

@@ -557,9 +557,9 @@ def test_coordinator_aggregate_weight_by_samples(tmp_path):
 
 def test_coordinator_cli_server_opt(tmp_path):
     """Cross-host FedOpt in the coordinator: a neutral server optimizer
-    (sgd lr=1, momentum=0) reproduces plain aggregation bit-for-bit, and
-    FedAvgM (momentum=0.9) actually changes the global — proving the
-    optimizer sits in the aggregation path on every process identically."""
+    (sgd lr=1, momentum=0) reproduces plain aggregation numerically, and
+    FedAvgM (momentum=0.9) actually changes the global; optimizer state is
+    hub-and-spoke — held by the server process only."""
     script = tmp_path / "coord_cli.py"
     script.write_text(COORD_CLI)
 
