@@ -32,6 +32,29 @@ import numpy as np
 from fedrec_tpu.cli.run import build_parser
 
 
+def apply_process_sharding(cfg, rt, server_trains: bool) -> None:
+    """Default ``data.num_shards``/``data.shard_index`` from the runtime so
+    each process trains a DISJOINT slice of the corpus — the reference's
+    per-rank ``DistributedSampler`` (reference ``main.py:166``,
+    ``client.py:243-249``). Explicit ``--set data.num_shards=...`` wins —
+    including ``data.num_shards=1``, which opts OUT (every host trains the
+    full corpus, the pre-sharding behavior).
+
+    With a non-training server (the reference deployment), shards are dealt
+    across the ``N-1`` training clients only; the reference shards across
+    the whole world, stranding the server's slice.
+    """
+    if rt.num_processes <= 1 or cfg.data.num_shards != 0:
+        return
+    if server_trains:
+        cfg.data.num_shards = rt.num_processes
+        cfg.data.shard_index = rt.process_id
+    else:
+        cfg.data.num_shards = max(rt.num_processes - 1, 1)
+        # the server (process 0) holds shard 0 but never trains on it
+        cfg.data.shard_index = max(rt.process_id - 1, 0)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     parser.add_argument("--coordinator", default=None, metavar="HOST:PORT",
@@ -76,6 +99,7 @@ def main(argv: list[str] | None = None) -> int:
         collective_timeout_s=args.collective_timeout or None,
         compress=cfg.fed.dcn_compress,
     )
+    apply_process_sharding(cfg, rt, args.server_trains)
 
     if args.synthetic:
         data = make_synthetic_mind(
@@ -96,7 +120,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.dp_epsilon > 0:
         cfg.privacy.enabled = True
         cfg.privacy.epsilon = args.dp_epsilon
-        cfg.privacy.sigma = calibrate_from_config(cfg, len(data.train_samples))
+        # calibrate against this HOST's actual training-set size: process
+        # sharding shrinks the local data, and a global-count calibration
+        # would underestimate the sample rate q and under-noise every round
+        # (privacy loss would exceed the configured epsilon)
+        n_local = len(data.train_samples)
+        if cfg.data.num_shards > 1:
+            # shard length by arithmetic: process_shard_indices deals
+            # perm[shard_index::num_shards] over n rows (index_samples is
+            # 1:1 with train_samples), so the count is independent of the
+            # permutation — no need to materialize it here
+            n_local = -(-(n_local - cfg.data.shard_index) // cfg.data.num_shards)
+        cfg.privacy.sigma = calibrate_from_config(cfg, n_local)
 
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
     local_snap = None
@@ -110,6 +145,25 @@ def main(argv: list[str] | None = None) -> int:
         snapshot_dir = Path(cfg.train.snapshot_dir or "snapshots")
         cfg.train.snapshot_dir = ""
     trainer = Trainer(cfg, data, token_states)
+    if rt.num_processes > 1 and rt.is_server:
+        # resolved config next to the snapshots for serving (fedrec-recommend
+        # reads it back — same contract as Trainer's orbax path; ADVICE r2).
+        # Server-only + atomic: per-process configs differ (shard_index,
+        # sigma) and concurrent non-atomic writes to a shared dir could tear
+        # the JSON a concurrently-running fedrec-recommend reads; serving
+        # always restores the SERVER's globals, so its config is the truth
+        from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+        snapshot_dir.mkdir(parents=True, exist_ok=True)
+        atomic_write_bytes(
+            snapshot_dir / "config.json", cfg.to_json().encode()
+        )
+    if cfg.data.num_shards > 1:
+        print(
+            f"[coordinator] process {rt.process_id} data shard "
+            f"{cfg.data.shard_index + 1}/{cfg.data.num_shards}: "
+            f"{trainer.num_local_samples} samples"
+        )
 
     server_optimizer = None
     if rt.num_processes > 1:
@@ -178,8 +232,16 @@ def main(argv: list[str] | None = None) -> int:
         # (classic FedAvg) instead of the reference's unweighted key-wise
         # mean over unequal shards (server.py:37-55)
         u0, n0 = trainer._client0_params()
-        w = float(len(data.train_samples)) if cfg.fed.weight_by_samples else 1.0
-        u, n = rt.aggregate((u0, n0), participated=trains, weight=w)
+        # weigh by the TRUE local shard size (classic FedAvg n_k) — before
+        # process sharding every host reported the identical global count,
+        # which made the weighting degenerate
+        w = float(trainer.num_local_samples) if cfg.fed.weight_by_samples else 1.0
+        # round_start_global switches int8 compression to delta
+        # quantization (every process holds the identical round-start
+        # global from the fan-out above)
+        u, n = rt.aggregate(
+            (u0, n0), participated=trains, weight=w, base=round_start_global
+        )
         if server_optimizer is not None:
             # server-only (hub-and-spoke): clients adopt the plain mean this
             # round and receive the server's post-opt global at the next

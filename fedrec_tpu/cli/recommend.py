@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default="-", help="output JSONL path ('-' = stdout)")
     p.add_argument("--uids", nargs="*", default=None,
                    help="subset of user ids (default: every known user)")
+    p.add_argument("--allow-random-states", action="store_true",
+                   help="permit serving with RANDOM trunk token states when "
+                        "token_states.npy is missing (smoke/testing only — "
+                        "the scores are meaningless)")
     p.add_argument("--batch-users", type=int, default=256)
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="SECTION.KEY=VALUE")
@@ -73,6 +77,28 @@ def main(argv: list[str] | None = None) -> int:
     cfg = ExperimentConfig()
     cfg.apply_overrides(args.overrides)
     snap_dir = args.snapshot_dir or cfg.train.snapshot_dir
+
+    # serve with the TRAINING run's resolved config when it was persisted
+    # next to the snapshots (Trainer/coordinator write config.json): a
+    # template-free restore otherwise trusts the operator to repeat every
+    # --set, and a mismatch yields an opaque shape error — or, worse,
+    # silently different scores for shape-compatible knobs like max_his_len
+    # (ADVICE r2). Explicit CLI --set still wins on top.
+    cfg_path = Path(snap_dir) / "config.json"
+    if cfg_path.exists():
+        try:
+            cfg = ExperimentConfig.from_dict(json.loads(cfg_path.read_text()))
+            cfg.apply_overrides(args.overrides)
+            print(f"[recommend] using training config {cfg_path}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — any malformed file degrades
+            # to the unverified-defaults path instead of crashing serving
+            print(f"[recommend] ignoring unreadable {cfg_path}: {e}",
+                  file=sys.stderr)
+    else:
+        print("[recommend] no config.json next to the snapshot — model "
+              "hyperparameters come from defaults + --set and are NOT "
+              "verified against the training run", file=sys.stderr)
 
     # two snapshot formats can coexist in one directory: orbax trees
     # (fedrec-run) and the coordinator deployment's flax-msgpack globals
@@ -156,12 +182,21 @@ def main(argv: list[str] | None = None) -> int:
         )
         if Path(token_path).exists():
             token_states = np.load(token_path)
-        else:
-            print(f"[recommend] no token states at {token_path}; using random "
-                  "(smoke mode)", file=sys.stderr)
+        elif args.allow_random_states:
+            print(f"[recommend] no token states at {token_path}; using RANDOM "
+                  "states (--allow-random-states) — scores are meaningless",
+                  file=sys.stderr)
             token_states = np.random.default_rng(0).standard_normal(
                 (data.num_news, data.title_len, cfg.model.bert_hidden)
             ).astype(np.float32)
+        else:
+            # hard error (ADVICE r2): silently substituting random trunk
+            # states produced normal-looking JSONL an operator could ship
+            print(f"[recommend] ERROR: no token states at {token_path}. "
+                  "Export them (fedrec_tpu.models.bert) or pass "
+                  "--token-states; use --allow-random-states only for "
+                  "smoke tests.", file=sys.stderr)
+            return 2
         table = encode_all_news(
             model, news_params,
             jnp.asarray(token_states, jnp.dtype(cfg.model.dtype)),

@@ -33,6 +33,16 @@ class DataConfig:
     # native/fedrec_data.cpp). Falls back to the Python batcher if the
     # library is unavailable.
     native_loader: bool = False
+    # cross-PROCESS disjoint data sharding (coordinator deployment): this
+    # host trains shard `shard_index` of `num_shards` equal-as-possible
+    # slices dealt from a (data.seed)-seeded permutation. The coordinator
+    # CLI defaults these from (process_id, num_processes) so each host
+    # trains disjoint data — the reference's DistributedSampler-by-rank
+    # (reference main.py:166, client.py:243-249). 0 = unset (the
+    # coordinator auto-shards); an EXPLICIT num_shards=1 opts out — every
+    # host trains the full corpus even multi-process.
+    num_shards: int = 0
+    shard_index: int = 0
     # static bound on unique news encoded per joint-mode step. 0 = the exact
     # worst case B*(C+H). Real batches hold far fewer distinct ids (history
     # padding collapses to one <unk> row; popular news repeat), so a cap cuts

@@ -11,6 +11,7 @@ from fedrec_tpu.data.batcher import (
     IndexedSamples,
     TrainBatcher,
     index_samples,
+    process_shard_indices,
     shard_indices,
 )
 from fedrec_tpu.data.adressa import (
@@ -57,6 +58,7 @@ __all__ = [
     "preprocess_adressa",
     "parse_news_tsv",
     "preprocess_mind",
+    "process_shard_indices",
     "shard_indices",
     "token_states_from_tokens",
     "write_artifacts",

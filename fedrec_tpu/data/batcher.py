@@ -54,6 +54,16 @@ class IndexedSamples:
     def __len__(self) -> int:
         return self.pos.shape[0]
 
+    def take(self, idx: np.ndarray) -> "IndexedSamples":
+        """Row subset (e.g. one process's shard of the sample set)."""
+        return IndexedSamples(
+            pos=self.pos[idx],
+            neg_pools=self.neg_pools[idx],
+            neg_lens=self.neg_lens[idx],
+            history=self.history[idx],
+            his_len=self.his_len[idx],
+        )
+
 
 def index_samples(samples: list, nid2index: dict, max_his_len: int) -> IndexedSamples:
     """One-time conversion of ``[uidx, pos, negs, his, uid]`` records to arrays."""
@@ -93,6 +103,25 @@ def shard_indices(
         # tiled wrap-around pad: fills even when num_shards > 2n
         idx = np.concatenate([idx, np.resize(idx, total - n)])
     return idx[shard_id::num_shards]
+
+
+def process_shard_indices(n: int, num_shards: int, shard_index: int, seed: int = 0) -> np.ndarray:
+    """Disjoint cross-PROCESS shard of ``range(n)`` for the coordinator
+    deployment — each host trains its own slice of the corpus, the premise
+    of federation. The reference shards by global rank via
+    ``DistributedSampler`` (reference ``main.py:166``, ``client.py:243-249``).
+
+    Divergence (ledger): ``DistributedSampler`` wrap-pads every rank to an
+    equal count, duplicating up to ``world-1`` samples globally. Here shards
+    are truly disjoint (sizes differ by at most 1) so that
+    ``fed.weight_by_samples`` weighs honest per-host counts. The permutation
+    is seeded, so every process deals the identical deck and the shards
+    partition the sample set exactly.
+    """
+    if not 0 <= shard_index < num_shards:
+        raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+    perm = np.random.default_rng((seed, 0xD15C)).permutation(n)
+    return np.sort(perm[shard_index::num_shards])
 
 
 class TrainBatcher:

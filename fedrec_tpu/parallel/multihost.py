@@ -111,7 +111,9 @@ def dequantize_weighted_mean(
     return np.einsum("p,p...->...", coeff, gathered_q.astype(np.float32))
 
 
-def aggregate_from_hosts(params: Any, weight: float = 1.0, compress: str = "none") -> Any:
+def aggregate_from_hosts(
+    params: Any, weight: float = 1.0, compress: str = "none", base: Any = None
+) -> Any:
     """Participation-weighted FedAvg across processes.
 
     Each process contributes its local parameter pytree with ``weight``
@@ -125,11 +127,27 @@ def aggregate_from_hosts(params: Any, weight: float = 1.0, compress: str = "none
     (:func:`broadcast_params`) stays full precision — quantizing the global
     model would bias every client's training, while quantizing the per-round
     CONTRIBUTIONS only adds zero-mean rounding noise to the mean.
+
+    ``base`` (int8 mode only): a pytree every process holds identically —
+    the round-start global from the server fan-out. When given, the round
+    DELTAS ``params - base`` are quantized instead of the absolute tensors
+    (ADVICE r2): one round's delta spans a far smaller range than the
+    parameters, so the same 127 levels bound the per-element error by
+    ``max|delta|/254`` instead of ``max|param|/254`` — and a single outlier
+    WEIGHT no longer degrades the whole tensor's resolution, only an
+    outlier single-round UPDATE would. The weighted mean commutes with the
+    shift: ``mean_w(params) == base + mean_w(params - base)`` exactly.
     """
     validate_compress(compress)
     w_arr = np.asarray(weight, np.float32)
     if compress == "int8":
         flat, treedef = jax.tree_util.tree_flatten(params)
+        if base is not None:
+            base_flat = jax.tree_util.tree_leaves(base)
+            flat = [
+                np.asarray(p, np.float32) - np.asarray(b, np.float32)
+                for p, b in zip(flat, base_flat)
+            ]
         pairs = [quantize_leaf(p) for p in flat]
         q = jax.tree_util.tree_unflatten(treedef, [x[0] for x in pairs])
         scales = jax.tree_util.tree_unflatten(treedef, [x[1] for x in pairs])
@@ -142,13 +160,19 @@ def aggregate_from_hosts(params: Any, weight: float = 1.0, compress: str = "none
         total = float(np.sum(weights))
         if total == 0.0:
             return params  # nobody reported; keep local (no NaNs)
-        return jax.tree_util.tree_map(
-            lambda gq, gs: jnp.asarray(
-                dequantize_weighted_mean(np.asarray(gq), np.asarray(gs), np.asarray(weights))
+        mean = jax.tree_util.tree_map(
+            lambda gq, gs: dequantize_weighted_mean(
+                np.asarray(gq), np.asarray(gs), np.asarray(weights)
             ),
             gathered_q,
             gathered_s,
         )
+        if base is not None:
+            return jax.tree_util.tree_map(
+                lambda m, b: jnp.asarray(m + np.asarray(b, np.float32)),
+                mean, base,
+            )
+        return jax.tree_util.tree_map(jnp.asarray, mean)
     weighted = jax.tree_util.tree_map(lambda p: np.asarray(p) * weight, params)
     gathered, weights = multihost_utils.process_allgather((weighted, w_arr))
     total = float(np.sum(weights))
@@ -246,16 +270,21 @@ class CoordinatorRuntime:
         )
 
     def aggregate(
-        self, params: Any, participated: bool = True, weight: float = 1.0
+        self, params: Any, participated: bool = True, weight: float = 1.0,
+        base: Any = None,
     ) -> Any:
         """Weighted FedAvg across processes. ``weight`` is this process's
         aggregation mass (e.g. its example count for classic FedAvg);
-        non-participants contribute 0 regardless."""
+        non-participants contribute 0 regardless. ``base`` (the round-start
+        global every process holds) switches int8 compression to tighter
+        delta quantization — see :func:`aggregate_from_hosts`."""
         if self.num_processes == 1:
             return params
         w = float(weight) if participated else 0.0
         return self._collective(
-            lambda: aggregate_from_hosts(params, w, compress=self.compress),
+            lambda: aggregate_from_hosts(
+                params, w, compress=self.compress, base=base
+            ),
             lambda: params,
         )
 

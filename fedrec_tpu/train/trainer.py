@@ -81,7 +81,18 @@ class Trainer:
         self.model = NewsRecommender(cfg.model)
         self.strategy = get_strategy(cfg.fed.strategy)
         self.server_opt = None
-        if cfg.fed.server_opt != "none" and self.strategy.sync_params_every_round:
+        if cfg.fed.server_opt != "none":
+            if not self.strategy.sync_params_every_round:
+                # fail fast (ADVICE r2, mirroring validate_compress): the
+                # server optimizer steps round deltas at param-sync time, so
+                # under local/grad_avg a requested FedAdam would silently
+                # never run
+                raise ValueError(
+                    f"fed.server_opt={cfg.fed.server_opt!r} requires a "
+                    "strategy that syncs params every round (param_avg or "
+                    f"coordinator); fed.strategy={cfg.fed.strategy!r} never "
+                    "would apply it"
+                )
             from fedrec_tpu.fed.strategies import ServerOptimizer
 
             self.server_opt = ServerOptimizer(
@@ -112,6 +123,19 @@ class Trainer:
             )
 
         train_ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
+        if cfg.data.num_shards > 1:
+            # coordinator deployment: this process trains only its disjoint
+            # shard (reference DistributedSampler-by-rank, main.py:166)
+            from fedrec_tpu.data.batcher import process_shard_indices
+
+            train_ix = train_ix.take(
+                process_shard_indices(
+                    len(train_ix), cfg.data.num_shards,
+                    cfg.data.shard_index, cfg.data.seed,
+                )
+            )
+        # true local sample count — what fed.weight_by_samples must weigh
+        self.num_local_samples = len(train_ix)
         batcher_cls = TrainBatcher
         if cfg.data.native_loader:
             from fedrec_tpu.data import native_batcher
@@ -165,6 +189,20 @@ class Trainer:
         self.snapshots: SnapshotManager | None = None
         if snapshot_dir or cfg.train.snapshot_dir:
             self.snapshots = SnapshotManager(snapshot_dir or cfg.train.snapshot_dir)
+            try:
+                # resolved config rides with the snapshots so serving can
+                # rebuild the exact model without the operator re-typing
+                # every --set (fedrec-recommend reads it back; ADVICE r2).
+                # Atomic: a concurrently-serving fedrec-recommend must never
+                # read a torn file
+                from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(
+                    self.snapshots.directory / "config.json",
+                    cfg.to_json().encode(),
+                )
+            except OSError as e:
+                print(f"[trainer] could not persist config.json: {e}")
             if cfg.train.resume and self.snapshots.latest_round() is not None:
                 self.state = self.snapshots.restore(self.state)
                 self.start_round = int(self.snapshots.latest_round()) + 1

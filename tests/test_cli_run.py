@@ -88,13 +88,28 @@ def test_recommend_cli_after_training(tmp_path):
     env = cpu_host_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out_path = tmp_path / "recs.jsonl"
-    proc = subprocess.run(
+    # without --allow-random-states a missing token_states.npy is a HARD
+    # error: random trunk states must never silently produce shippable
+    # JSONL (ADVICE r2)
+    denied = subprocess.run(
         [sys.executable, "-m", "fedrec_tpu.cli.recommend",
          "--data-dir", shard, "--snapshot-dir", str(tmp_path / "snapshots"),
          "--top-k", "5", "--out", str(out_path), *common],
         env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
     )
+    assert denied.returncode == 2
+    assert "no token states" in denied.stderr
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.recommend",
+         "--data-dir", shard, "--snapshot-dir", str(tmp_path / "snapshots"),
+         "--top-k", "5", "--out", str(out_path), "--allow-random-states",
+         *common],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    # the training run persisted its resolved config; serving must use it
+    assert "using training config" in proc.stderr
 
     import pickle
     with open(Path(shard) / "bert_nid2index.pkl", "rb") as f:
@@ -150,7 +165,7 @@ def test_recommend_cli_from_coordinator_global(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "fedrec_tpu.cli.recommend",
          "--data-dir", shard, "--snapshot-dir", str(snap_dir),
-         "--top-k", "4", "--out", str(out_path),
+         "--top-k", "4", "--out", str(out_path), "--allow-random-states",
          "--set", "model.bert_hidden=32", "--set", "model.news_dim=32",
          "--set", "model.num_heads=4", "--set", "model.head_dim=8",
          "--set", "model.query_dim=16", "--set", "data.max_his_len=10"],

@@ -641,6 +641,42 @@ def test_quantize_dequantize_bounds():
     np.testing.assert_allclose(got, excluded, rtol=1e-6)
 
 
+def test_quantize_delta_tighter_than_absolute():
+    """Delta quantization (ADVICE r2): with a shared round-start base, the
+    int8 error is bounded by the DELTA's range, not the parameter's — an
+    outlier weight no longer destroys the whole tensor's resolution."""
+    from fedrec_tpu.parallel.multihost import quantize_leaf
+
+    rng = np.random.default_rng(1)
+    base = rng.standard_normal(512).astype(np.float32)
+    base[0] = 100.0  # outlier WEIGHT (persists across rounds)
+    delta = (1e-3 * rng.standard_normal(512)).astype(np.float32)
+    p = base + delta
+
+    # absolute quantization: error floor set by the outlier, ~0.4 worst case
+    q_abs, s_abs = quantize_leaf(p)
+    err_abs = np.max(np.abs(q_abs.astype(np.float32) * s_abs - p))
+    # delta quantization: error bounded by max|delta|/254 ~ 2e-5
+    q_d, s_d = quantize_leaf(p - base)
+    err_d = np.max(np.abs((q_d.astype(np.float32) * s_d + base) - p))
+    assert err_d < 1e-4 < err_abs
+    # quantization bound max|delta|/254 plus the f32 rounding floor of the
+    # subtraction/add at the outlier's magnitude (eps * 100 ~ 1.2e-5)
+    assert err_d <= np.max(np.abs(delta)) / 254 + 2 ** -23 * 100 + 1e-7
+
+
+def test_server_opt_requires_syncing_strategy(tmp_path):
+    """fed.server_opt with a never-syncing strategy fails FAST instead of
+    silently running plain behavior (ADVICE r2)."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__strategy="grad_avg", fed__server_opt="adam")
+    cfg.model.text_encoder_mode = "head"
+    data, token_states = tiny_data(cfg)
+    with pytest.raises(ValueError, match="server_opt"):
+        Trainer(cfg, data, token_states)
+
+
 def test_coordinator_cli_int8_compression(tmp_path):
     """fed.dcn_compress=int8 over two real processes: training completes and
     the final global matches the uncompressed run within the accumulated
