@@ -46,6 +46,12 @@ if str(REPO) not in sys.path:  # runnable as `python benchmarks/accuracy_run.py`
     sys.path.insert(0, str(REPO))
 
 
+def _prov() -> dict:
+    from fedrec_tpu.utils.provenance import provenance
+
+    return provenance()
+
+
 # --------------------------------------------------------------------- data
 def _central_corpus():
     from fedrec_tpu.data import make_synthetic_mind_topics
@@ -202,6 +208,8 @@ def leg_central(rounds: int) -> None:
                    "lr": cfg.optim.user_lr, "batch": cfg.data.batch_size},
     }
 
+    out["provenance"] = _prov()
+
     def persist(partial):
         (HERE / "accuracy_central.json").write_text(
             json.dumps({**out, **partial}, indent=2)
@@ -285,6 +293,7 @@ def leg_fed(rounds: int) -> None:
         "oracle_auc": round(oracle_auc(data, states), 4),
         "runs": runs,
     }
+    out["provenance"] = _prov()
     (HERE / "accuracy_fed.json").write_text(json.dumps(out, indent=2))
 
 
@@ -339,6 +348,8 @@ def leg_adressa(rounds: int) -> None:
         "config": {"mode": "head", "dtype": cfg.model.dtype,
                    "lr": cfg.optim.user_lr, "batch": cfg.data.batch_size},
     }
+
+    out["provenance"] = _prov()
 
     def persist(partial):
         (HERE / "accuracy_adressa.json").write_text(
@@ -412,6 +423,8 @@ def leg_finetune(rounds: int) -> None:
         "config": {"mode": "finetune", "dtype": cfg.model.dtype,
                    "lr": cfg.optim.user_lr, "batch": cfg.data.batch_size},
     }
+
+    out["provenance"] = _prov()
 
     def persist(partial):
         (HERE / "accuracy_finetune.json").write_text(

@@ -112,6 +112,9 @@ def main() -> int:
             "sync, riding ICI instead of EC2 TCP."
         ),
     }
+    from fedrec_tpu.utils.provenance import provenance
+
+    out["provenance"] = provenance()
     (HERE / "comm_cost.json").write_text(json.dumps(out, indent=2))
     print(json.dumps(out))
     return 0

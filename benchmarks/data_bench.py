@@ -99,15 +99,30 @@ def main() -> int:
         )  # samples packed per sharded epoch
         t_ep = time_call(lambda: nb_s.epoch_arrays_sharded(args.clients, 0))
         out["native_epoch_threaded"] = round(n_shard / t_ep, 1)
+        # same bulk-epoch call pinned to ONE thread: separates the bulk-
+        # packing gain (one FFI call, no per-batch Python) from actual
+        # thread parallelism — on a 1-core host these two rates should
+        # match, and the threaded/python ratio is NOT a parallelism claim
+        nb_1 = NativeTrainBatcher(
+            indexed, batch_size=args.batch, seed=1, num_threads=1
+        )
+        t_ep1 = time_call(lambda: nb_1.epoch_arrays_sharded(args.clients, 0))
+        out["native_epoch_1thread"] = round(n_shard / t_ep1, 1)
         out["clients"] = args.clients
         out["threads"] = args.threads
         out["speedup_native"] = round(out["native_batcher"] / out["python_batcher"], 2)
         out["speedup_threaded"] = round(
             out["native_epoch_threaded"] / out["python_batcher"], 2
         )
+        out["speedup_threads_only"] = round(
+            out["native_epoch_threaded"] / out["native_epoch_1thread"], 2
+        )
     else:
         out["native_batcher"] = None
 
+    from fedrec_tpu.utils.provenance import provenance
+
+    out["provenance"] = provenance()
     (HERE / "data_bench.json").write_text(json.dumps(out, indent=2))
     print(json.dumps(out))
     return 0

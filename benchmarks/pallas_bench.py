@@ -208,9 +208,11 @@ def main() -> int:
                     "pallas_ms": t_p and t_p * 1e3,
                     "chunked_ms": t_c and t_c * 1e3})
 
+    from fedrec_tpu.utils.provenance import provenance
+
     Path(__file__).with_name("pallas_bench.json").write_text(
         json.dumps({"platform": platform, "batch": B, "rows": out,
-                    "skipped": skips}, indent=2)
+                    "skipped": skips, "provenance": provenance()}, indent=2)
     )
     return 0
 

@@ -129,8 +129,11 @@ def main() -> int:
         out[name] = round(t * 1e3, 4)
         print(f"{name:16s} {t*1e3:8.3f} ms", flush=True)
 
+    from fedrec_tpu.utils.provenance import provenance
+
     Path(__file__).with_name("step_profile.json").write_text(
-        json.dumps({"B": B, "components_ms": out}, indent=2)
+        json.dumps({"B": B, "components_ms": out,
+                    "provenance": provenance()}, indent=2)
     )
     return 0
 

@@ -120,7 +120,13 @@ def run(batch_size=64, cand=5, his_len=50, title_len=50, num_news=4096,
 
 
 if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from fedrec_tpu.utils.provenance import provenance
+
     result = run()
+    result["provenance"] = provenance()
     out = Path(__file__).parent / "baseline_host.json"
     out.write_text(json.dumps(result, indent=2))
     print(json.dumps(result, indent=2))

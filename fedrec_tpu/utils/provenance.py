@@ -1,0 +1,58 @@
+"""Host/commit provenance stamp for benchmark artifacts.
+
+Every ``benchmarks/*.json`` must self-describe where and when it was
+measured (VERDICT r2 item 8: an artifact claiming 8 threads on a 1-core rig
+was unexplainable because nothing recorded the host). Merge
+``{"provenance": provenance()}`` into the payload at write time.
+"""
+
+from __future__ import annotations
+
+import os
+import platform as _platform
+import subprocess
+import time
+from pathlib import Path
+
+
+def git_head(repo: Path | None = None) -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=repo or Path(__file__).resolve().parents[2],
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def provenance(**extra) -> dict:
+    """Stamp: commit, wall time, machine, CPU count, and the JAX backend
+    actually in use (when JAX is already imported — never imports it)."""
+    stamp = {
+        "commit": git_head(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "hostname": _platform.node(),
+        "machine": _platform.machine(),
+        "nproc": os.cpu_count(),
+    }
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            # ONLY report an already-initialized backend: default_backend()
+            # would otherwise trigger backend init here, and on an axon host
+            # with a wedged TPU tunnel that call hangs forever (observed —
+            # it froze data_bench.py for minutes before timeout)
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:
+                stamp["jax_backend"] = jax.default_backend()
+                stamp["jax_device"] = getattr(
+                    jax.devices()[0], "device_kind", "unknown"
+                )
+        except Exception:  # noqa: BLE001
+            pass
+    stamp.update(extra)
+    return stamp
