@@ -1,0 +1,137 @@
+"""Real-MIND readiness on the committed ``tests/fixtures/mind_mini`` fixture.
+
+VERDICT r2 item 4: the real-data path needs one integration proof, not just
+format unit tests. The fixture is schema-faithful to the public MIND release
+(8-column ``news.tsv``, 5-column ``behaviors.tsv`` with ``N-1``/``N-0``
+labels, BERT-layout ``vocab.txt``); with it committed, the only untested
+step on real MIND is the download itself (see the fixture README for the
+exact real-MIND commands).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+FIX = Path(__file__).resolve().parent / "fixtures" / "mind_mini"
+
+# WordPiece goldens: ids precomputed ONCE with transformers.BertTokenizer
+# built from the committed vocab.txt (see test_wordpiece_matches_hf_live for
+# the live cross-check). Literal so the contract holds even where
+# transformers is absent. Frame: [CLS] pieces [SEP] pad -> len 16.
+GOLDEN_IDS = {
+    "Team wins cup final":
+        [5, 39, 32, 42, 43, 6, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+    "Stock market rise today, bank profit falls!":
+        [5, 48, 49, 52, 101, 8, 55, 56, 53, 34, 10, 6, 0, 0, 0, 0],
+    "Record heat this year: flood risk for the city?":
+        [5, 67, 68, 4, 102, 12, 70, 88, 26, 19, 89, 11, 6, 0, 0, 0],
+    # out-of-vocab words must each collapse to one [UNK] (id 4)
+    "Unmatchable zebra wordxyz":
+        [5, 4, 4, 4, 6, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+    # suffix pieces: snows -> snow ##s, falling -> fall ##ing, warmly -> warm ##ly
+    "The early snows falling warmly":
+        [5, 19, 113, 63, 34, 53, 35, 66, 38, 6, 0, 0, 0, 0, 0, 0],
+}
+
+
+def test_fixture_files_parse():
+    from fedrec_tpu.data import parse_behaviors_tsv, parse_news_tsv
+
+    titles = parse_news_tsv(FIX / "news.tsv")
+    assert len(titles) == 24
+    assert all(n.startswith("N") for n in titles)
+
+    samples = parse_behaviors_tsv(FIX / "behaviors.tsv", set(titles))
+    assert len(samples) == 96  # one click per impression in this fixture
+    for uidx, pos, pool, his, uid in samples[:10]:
+        assert pos in titles and all(n in titles for n in pool + his)
+        assert len(pool) == 3 and len(his) == 4
+        assert uid.startswith("U")
+
+
+def test_wordpiece_goldens_literal():
+    from fedrec_tpu.data import WordPieceTokenizer
+
+    tok = WordPieceTokenizer(FIX / "vocab.txt")
+    assert tok.pad_id == 0  # [PAD] is line 0 of the committed vocab
+    for sentence, want in GOLDEN_IDS.items():
+        ids, mask = tok.encode(sentence, 16)
+        assert list(ids) == want, sentence
+        # no golden token is legitimately id 0, so mask == (ids != PAD)
+        np.testing.assert_array_equal(mask, np.asarray(want) != 0)
+
+
+def test_wordpiece_matches_hf_live():
+    """The SAME vocab file through transformers' BertTokenizer: every golden
+    sentence AND every fixture title tokenizes identically."""
+    transformers = pytest.importorskip("transformers")
+    from fedrec_tpu.data import WordPieceTokenizer, parse_news_tsv
+
+    ours = WordPieceTokenizer(FIX / "vocab.txt")
+    hf = transformers.BertTokenizer(str(FIX / "vocab.txt"), do_lower_case=True)
+    titles = list(parse_news_tsv(FIX / "news.tsv").values())
+    for s in list(GOLDEN_IDS) + titles:
+        ids, _ = ours.encode(s, 16)
+        hf_ids = hf.encode(s, add_special_tokens=True, max_length=16,
+                           truncation=True, padding="max_length")
+        assert list(ids) == list(hf_ids), s
+
+
+def test_preprocess_train_evaluate_end_to_end(tmp_path):
+    """The full real-data journey on the committed fixture: preprocess CLI ->
+    reference-format artifacts -> artifact loader -> token-derived trunk
+    states -> Trainer -> deterministic full-pool evaluation."""
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.data import load_mind_artifacts, token_states_from_tokens
+    from fedrec_tpu.data.preprocess import main as preprocess_main
+    from fedrec_tpu.train.trainer import Trainer
+
+    out = tmp_path / "UserData"
+    rc = preprocess_main([
+        "--news", str(FIX / "news.tsv"),
+        "--train-behaviors", str(FIX / "behaviors.tsv"),
+        "--valid-behaviors", str(FIX / "behaviors_valid.tsv"),
+        "--out-dir", str(out), "--vocab", str(FIX / "vocab.txt"),
+        "--max-title-len", "12",
+    ])
+    assert rc == 0
+    for f in ("bert_news_index.npy", "bert_nid2index.pkl",
+              "train_sam_uid.pkl", "valid_sam_uid.pkl"):
+        assert (out / f).exists()
+
+    data = load_mind_artifacts(out)
+    assert data.num_news == 25  # 24 news + <unk> row 0
+    assert data.nid2index["<unk>"] == 0
+    assert data.news_tokens.shape == (25, 2, 12)
+    assert len(data.train_samples) == 96 and len(data.valid_samples) == 32
+
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.data.max_his_len = 6
+    cfg.data.max_title_len = 12
+    cfg.data.batch_size = 16
+    cfg.fed.num_clients = 2
+    cfg.fed.rounds = 4
+    cfg.fed.strategy = "param_avg"
+    cfg.optim.user_lr = cfg.optim.news_lr = 5e-3  # tiny corpus, few rounds
+    cfg.train.snapshot_dir = str(tmp_path / "snap")
+    cfg.train.eval_protocol = "full"
+
+    states = token_states_from_tokens(data.news_tokens, cfg.model.bert_hidden)
+    trainer = Trainer(cfg, data, states)
+    history = trainer.run()
+    assert len(history) == 4
+    assert history[-1].train_loss < history[0].train_loss
+    m = history[-1].val_metrics
+    assert all(np.isfinite(v) for v in m.values())
+    assert set(m) == {"auc", "mrr", "ndcg5", "ndcg10"}
+    # the fixture is topic-structured: the learned ranking must beat chance
+    assert m["auc"] > 0.5
