@@ -81,7 +81,13 @@ class ModelConfig:
     # score->loss parity: CE over sigmoid(scores) (reference ``model.py:123-126``)
     sigmoid_before_ce: bool = True
     dtype: str = "float32"             # compute dtype for encoders ("bfloat16" on TPU)
-    use_pallas: bool = False           # route hot ops through Pallas kernels
+    # Route hot ops through the Pallas kernels. EXPERIMENTAL OPT-IN: at every
+    # chip-measured size so far the XLA dense path wins (20-dim heads pad to
+    # 128 lanes; benchmarks/pallas_bench.json), so 'auto' NEVER selects
+    # pallas unless this flag is set. The kernels now carry a blocked O(L)
+    # FlashAttention-2 backward; re-judge on the H>=2048 rows of the next
+    # chip run of benchmarks/pallas_bench.py before promoting.
+    use_pallas: bool = False
     # user-encoder self-attention implementation:
     #   "auto"    — dense XLA up to attn_chunk_threshold history items, then
     #               blockwise lax.scan (O(L) memory); pallas if use_pallas

@@ -14,9 +14,11 @@ Two kernels cover the recommender's attention math (reference
     ``attention.py:14-26``).
 
 Kernels auto-fall back to interpret mode off-TPU so the same code path is
-exercised by CPU tests. Backward passes go through ``jax.custom_vjp`` with a
-dense recompute (correct, memory-light at training shapes); a blocked
-backward kernel is a future optimization.
+exercised by CPU tests. ``flash_attention``'s backward is a blocked Pallas
+kernel pair (FlashAttention-2 style: forward saves the per-row log-sum-exp;
+backward rebuilds p blockwise — O(L) memory end to end, VERDICT r2 item 6).
+``additive_pool``'s backward stays a dense ``jax.vjp`` recompute: its math
+has no (L, L) term, so the recompute is already O(L)-memory.
 
 Layout notes (guide: /opt/skills/guides/pallas_guide.md): last dim padded to
 128 lanes, blocks padded to 8-sublane multiples, matmuls carry
@@ -51,10 +53,14 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
 
 
 # ============================================================ flash attention
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, scale: float):
+def _flash_kernel(
+    q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int, scale: float
+):
     """One (batch*head, q-block) program: online softmax over key blocks.
 
     q_ref: (1, block_q, dk)   k_ref/v_ref: (1, L_pad, dk)   bias: (1, 1, L_pad)
+    Also writes the per-row log-sum-exp (``lse_ref``: (1, 1, block_q)) — the
+    residual the blocked backward needs to rebuild p without a dense pass.
     """
     q = q_ref[0].astype(jnp.float32) * scale            # (bq, dk)
     l_pad = k_ref.shape[1]
@@ -83,7 +89,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, scale: 
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, l_pad // block_k, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _flash_pad(q, k, v, bias, block_q, block_k):
+    """Shared hardware-tile padding; padded keys are masked via the bias."""
+    lk = bias.shape[1]
+    qp = _pad_to(_pad_to(q, 2, _LANE), 1, block_q)
+    kp = _pad_to(_pad_to(k, 2, _LANE), 1, block_k)
+    vp = _pad_to(_pad_to(v, 2, _LANE), 1, block_k)
+    biasp = _pad_to(bias, 1, block_k)
+    if biasp.shape[1] > lk:
+        biasp = biasp.at[:, lk:].set(_NEG_INF)
+    return qp, kp, vp, biasp[:, None, :]                 # bias -> (BH, 1, Lk_pad)
 
 
 def _flash_forward(
@@ -93,26 +113,21 @@ def _flash_forward(
     bias: jnp.ndarray,
     block_q: int,
     block_k: int,
-) -> jnp.ndarray:
-    """(BH, Lq, dk) x (BH, Lk, dk) x (BH, Lk, dv) + key bias (BH, Lk) -> (BH, Lq, dv)."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(BH, Lq, dk) x (BH, Lk, dk) x (BH, Lk, dv) + key bias (BH, Lk)
+    -> ((BH, Lq, dv) out, (BH, Lq) log-sum-exp)."""
     bh, lq, dk = q.shape
     dv = v.shape[-1]
     scale = 1.0 / (dk ** 0.5)
-
-    # pad to hardware tiles; padded keys are masked via the bias
-    qp = _pad_to(_pad_to(q, 2, _LANE), 1, block_q)
-    kp = _pad_to(_pad_to(k, 2, _LANE), 1, block_k)
-    vp = _pad_to(_pad_to(v, 2, _LANE), 1, block_k)
-    biasp = _pad_to(bias, 1, block_k)
-    if biasp.shape[1] > bias.shape[1]:
-        biasp = biasp.at[:, bias.shape[1]:].set(_NEG_INF)
-    biasp = biasp[:, None, :]                            # (BH, 1, Lk_pad)
-
+    qp, kp, vp, biasp = _flash_pad(q, k, v, bias, block_q, block_k)
     lq_pad, lk_pad = qp.shape[1], kp.shape[1]
     grid = (bh, lq_pad // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, vp.shape[2]), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lq_pad, vp.shape[2]), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, lq_pad), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
@@ -120,14 +135,100 @@ def _flash_forward(
             pl.BlockSpec((1, lk_pad, vp.shape[2]), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, lk_pad), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, vp.shape[2]), lambda b, i: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, vp.shape[2]), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ),
         interpret=_interpret(),
     )(qp, kp, vp, biasp)
-    return out[:, :lq, :dv]
+    return out[:, :lq, :dv], lse[:, 0, :lq]
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, bias_ref, do_ref, delta_ref, lse_ref, dq_ref,
+    *, block_k: int, scale: float,
+):
+    """dq for one (batch*head, q-block): stream key blocks, rebuild p from
+    the saved log-sum-exp (FlashAttention-2 backward, q-parallel half)."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :].astype(jnp.float32)           # (bq,)
+    delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]  # (bq, 1)
+    l_pad = k_ref.shape[1]
+    acc0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
+
+    def body(i, acc):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        b = bias_ref[0, 0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + b[None, :]
+        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    acc = jax.lax.fori_loop(0, l_pad // block_k, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, bias_ref, q_ref, do_ref, delta_ref, lse_ref,
+    dk_ref, dv_ref, dbias_ref,
+    *, block_q: int, scale: float,
+):
+    """dk/dv/dbias for one (batch*head, k-block): stream query blocks
+    (FlashAttention-2 backward, k-parallel half)."""
+    k = k_ref[0].astype(jnp.float32)                     # (bk, dk)
+    v = v_ref[0].astype(jnp.float32)
+    b = bias_ref[0, 0, :].astype(jnp.float32)            # (bk,)
+    lq_pad = q_ref.shape[1]
+    block_k, dk_dim = k.shape
+    dv_dim = v.shape[1]
+    init = (
+        jnp.zeros((block_k, dk_dim), jnp.float32),
+        jnp.zeros((block_k, dv_dim), jnp.float32),
+        jnp.zeros((1, block_k), jnp.float32),
+    )
+
+    def body(i, carry):
+        dk_acc, dv_acc, db_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32
+        )[:, None]                                       # (bq, 1)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + b[None, :]                                   # (bq, bk)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        db_acc = db_acc + jnp.sum(ds, axis=0)[None, :]
+        return dk_acc, dv_acc, db_acc
+
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(0, lq_pad // block_q, body, init)
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    dbias_ref[0, 0, :] = db_acc[0].astype(dbias_ref.dtype)
 
 
 def _attention_dense(q, k, v, bias):
-    """Reference dense math (also the backward recompute)."""
+    """Reference dense math (golden path for kernel tests)."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale + bias[:, None, :]
     p = jax.nn.softmax(s, axis=-1)
@@ -136,18 +237,87 @@ def _attention_dense(q, k, v, bias):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, bias, block_q, block_k):
-    return _flash_forward(q, k, v, bias, block_q, block_k)
+    out, _ = _flash_forward(q, k, v, bias, block_q, block_k)
+    return out
 
 
 def _flash_fwd(q, k, v, bias, block_q, block_k):
-    return _flash_forward(q, k, v, bias, block_q, block_k), (q, k, v, bias)
+    out, lse = _flash_forward(q, k, v, bias, block_q, block_k)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(block_q, block_k, res, g):
-    q, k, v, bias = res
-    _, vjp = jax.vjp(_attention_dense, q, k, v, bias)
-    dq, dk, dv, dbias = vjp(g)
-    return dq, dk, dv, dbias
+    """Blocked backward: O(L) memory like the forward (VERDICT r2 item 6 —
+    the previous dense recompute materialized the (L, L) scores, capping the
+    kernel at exactly the sizes dense attention fits anyway)."""
+    q, k, v, bias = res[:4]
+    out, lse = res[4], res[5]
+    bh, lq, dk_dim = q.shape
+    lk, dv_dim = v.shape[1], v.shape[2]
+    scale = 1.0 / (dk_dim ** 0.5)
+
+    qp, kp, vp, biasp = _flash_pad(q, k, v, bias, block_q, block_k)
+    # padded q rows carry do=0, so they contribute nothing to dk/dv/dbias
+    dop = _pad_to(_pad_to(g, 2, _LANE), 1, block_q)
+    # FA2's delta = rowsum(do * o), computed ONCE here (XLA) instead of per
+    # (k-block x q-block) program inside the kernels; o itself is then not
+    # needed by the kernels at all
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    deltap = _pad_to(delta, 1, block_q)[:, None, :]      # (BH, 1, Lq_pad)
+    lsep = _pad_to(lse, 1, block_q)[:, None, :]          # (BH, 1, Lq_pad)
+    lq_pad, lk_pad = qp.shape[1], kp.shape[1]
+    dkp_dim, dvp_dim = kp.shape[2], vp.shape[2]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, qp.shape[2]), q.dtype),
+        grid=(bh, lq_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk_pad, dkp_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk_pad, dvp_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, lk_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dvp_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
+        interpret=_interpret(),
+    )(qp, kp, vp, biasp, dop, deltap, lsep)
+
+    dk, dv, dbias = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lk_pad, dkp_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk_pad, dvp_dim), v.dtype),
+            jax.ShapeDtypeStruct((bh, 1, lk_pad), bias.dtype),
+        ),
+        grid=(bh, lk_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((1, lq_pad, qp.shape[2]), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, lq_pad, dvp_dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, lq_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, lq_pad), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
+        ),
+        interpret=_interpret(),
+    )(kp, vp, biasp, qp, dop, deltap, lsep)
+
+    return (
+        dq[:, :lq, :dk_dim],
+        dk[:, :lk, :dk_dim],
+        dv[:, :lk, :dv_dim],
+        dbias[:, 0, :lk],
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
