@@ -198,6 +198,16 @@ class CoordinatorRuntime:
     2-day gloo timeout and then dies (``client.py:227``,
     Final_Report.pdf VII.a). Planned per-round sit-outs don't need this —
     they are weight-0 participation in :meth:`aggregate`.
+
+    Slow (not dead) peers: a host that stalls past the watchdog and then
+    recovers degrades via its OWN watchdog at its next collective and
+    finishes standalone — with one platform caveat. The JAX coordination
+    service lives in process 0 (like torchrun's c10d rendezvous), so if the
+    SERVER has already degraded and exited by the time a slow client wakes,
+    the client's distributed runtime fatally terminates it: a bounded
+    crash, never a wedge. Both directions are pinned by
+    ``test_coordinator_slow_server_recovers`` /
+    ``test_coordinator_slow_client_bounded_termination``.
     """
 
     def __init__(

@@ -21,6 +21,9 @@ from fedrec_tpu.hostenv import cpu_host_env
 
 REPO = str(Path(__file__).resolve().parents[1])
 
+# every test here drives full CLI subprocesses — minutes, not seconds
+pytestmark = pytest.mark.slow
+
 
 def _run_cli(args: list[str], tmp_path, timeout: int = 300) -> str:
     env = cpu_host_env(2)

@@ -186,6 +186,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_coordinator_two_process_disjoint_shards(tmp_path):
     """VERDICT r2 item 1 'Done' criterion over two REAL processes: (a) the
     processes' data is disjoint, (b) sample-weighted aggregation of unequal

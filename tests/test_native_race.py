@@ -6,6 +6,10 @@ its own TSAN binary and must run regardless.
 """
 
 import pytest
+
+pytestmark = pytest.mark.slow  # compiles + runs a TSAN binary
+
+
 def test_native_engine_tsan_clean():
     """Build the engine + stress harness under ThreadSanitizer and run it:
     threaded epoch fill, concurrent epoch-order cache rebuilds, and

@@ -169,6 +169,7 @@ def test_hashing_tokenizer_deterministic():
     assert a[0][1] >= 104  # hashed ids clear the special-token floor
 
 
+@pytest.mark.slow
 def test_preprocess_mind_small_scale(tmp_path):
     """Pipeline at realistic scale: 10k news / 24k behavior lines through
     the CLI -> loader round-trip (the shipped reference shard is only 225
