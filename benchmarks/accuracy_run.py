@@ -222,6 +222,95 @@ def leg_central(rounds: int) -> None:
                       "wall_s": result["wall_s"]}))
 
 
+def leg_bf16(rounds: int) -> None:
+    """Dtype-tolerance leg (VERDICT r2 item 9): the SAME corpus and config
+    trained twice — float32 vs bfloat16 (params/opt stay f32; compute and
+    the token-state table take the dtype, exactly like the TPU bench) —
+    asserting the final full-pool AUC agrees within a stated tolerance.
+    The TPU bench advertises bfloat16; this leg is the accuracy proof for
+    that dtype. CPU runs use the small corpus (XLA:CPU bf16 is slow)."""
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+
+    platform = jax.devices()[0].platform
+    if os.environ.get("FEDREC_ACC_SMOKE"):
+        from fedrec_tpu.data import make_synthetic_mind_topics
+
+        data, states = make_synthetic_mind_topics(
+            num_news=256, num_train=400, num_valid=100, title_len=8,
+            bert_hidden=96, his_len_range=(3, 10), seed=7,
+        )
+    elif platform == "cpu":
+        data, states = _small_corpus()
+    else:
+        data, states = _central_corpus()
+    hidden = states.shape[-1]
+
+    def cfg_for(dtype: str) -> ExperimentConfig:
+        cfg = ExperimentConfig()
+        cfg.model.text_encoder_mode = "head"
+        cfg.model.bert_hidden = hidden
+        if hidden < 768:  # CPU-scale corpus -> proportionally scaled model
+            cfg.model.news_dim = 128
+            cfg.model.num_heads = 16
+            cfg.model.head_dim = 8
+            cfg.model.query_dim = 64
+        cfg.data.max_title_len = data.title_len
+        cfg.model.dtype = dtype
+        cfg.fed.strategy = "local"
+        cfg.fed.num_clients = 1
+        cfg.fed.rounds = rounds
+        cfg.optim.user_lr = cfg.optim.news_lr = 5e-4
+        cfg.train.eval_protocol = "full"
+        cfg.train.eval_every = 1
+        cfg.train.snapshot_dir = ""
+        cfg.train.resume = False
+        return cfg
+
+    tolerance = 0.02
+    out = {
+        "leg": "bf16",
+        "platform": platform,
+        "device": getattr(jax.devices()[0], "device_kind", platform),
+        "corpus": {
+            "num_news": data.num_news,
+            "train": len(data.train_samples),
+            "valid": len(data.valid_samples),
+            "bert_hidden": hidden,
+        },
+        "oracle_auc": round(oracle_auc(data, states), 4),
+        "rounds_requested": rounds,
+        "tolerance_auc": tolerance,
+        "runs": {},
+    }
+    out["provenance"] = _prov()
+
+    def persist(_partial=None):
+        (HERE / "accuracy_bf16.json").write_text(json.dumps(out, indent=2))
+
+    for dtype in ("float32", "bfloat16"):
+        print(f"[bf16-leg] training dtype={dtype}", flush=True)
+        res = _train(cfg_for(dtype), data, states, on_round=lambda p: persist())
+        out["runs"][dtype] = res
+        persist()
+
+    f32_auc = out["runs"]["float32"]["curve"][-1]["auc"]
+    bf16_auc = out["runs"]["bfloat16"]["curve"][-1]["auc"]
+    out["final_auc"] = {"float32": f32_auc, "bfloat16": bf16_auc}
+    out["auc_delta"] = round(abs(f32_auc - bf16_auc), 5)
+    out["within_tolerance"] = out["auc_delta"] <= tolerance
+    persist()
+    print(json.dumps({"leg": "bf16", "auc_f32": f32_auc, "auc_bf16": bf16_auc,
+                      "delta": out["auc_delta"],
+                      "within_tolerance": out["within_tolerance"]}))
+    if not out["within_tolerance"]:
+        raise SystemExit(
+            f"bf16 final AUC diverged from f32 by {out['auc_delta']} "
+            f"(> {tolerance}) — the bench dtype is not accuracy-safe"
+        )
+
+
 def leg_fed(rounds: int) -> None:
     import jax
 
@@ -468,7 +557,7 @@ def _partial_note(leg: dict) -> str:
 def write_report() -> None:
     """Collect whichever leg JSONs exist into RESULTS.md (a wedged TPU
     tunnel can leave one leg missing — report the evidence that exists)."""
-    central = fed = adressa = finetune = None
+    central = fed = adressa = finetune = bf16 = None
     if (HERE / "accuracy_central.json").exists():
         central = json.loads((HERE / "accuracy_central.json").read_text())
     if (HERE / "accuracy_fed.json").exists():
@@ -477,7 +566,9 @@ def write_report() -> None:
         adressa = json.loads((HERE / "accuracy_adressa.json").read_text())
     if (HERE / "accuracy_finetune.json").exists():
         finetune = json.loads((HERE / "accuracy_finetune.json").read_text())
-    if central is None and fed is None and adressa is None and finetune is None:
+    if (HERE / "accuracy_bf16.json").exists():
+        bf16 = json.loads((HERE / "accuracy_bf16.json").read_text())
+    if all(x is None for x in (central, fed, adressa, finetune, bf16)):
         raise SystemExit("no accuracy_*.json found; run the legs first")
 
     lines = [
@@ -592,6 +683,21 @@ def write_report() -> None:
         ]
     lines += [
         "",
+        *([
+            "",
+            "## Dtype tolerance (bfloat16 vs float32)",
+            "",
+            f"Same corpus/config trained in both dtypes on "
+            f"**{bf16['platform']}** ({bf16['device']}); final full-pool "
+            f"AUC — f32 **{bf16['final_auc']['float32']:.4f}** vs bf16 "
+            f"**{bf16['final_auc']['bfloat16']:.4f}** "
+            f"(delta {bf16['auc_delta']:.4f}, tolerance "
+            f"{bf16['tolerance_auc']}): "
+            + ("**within tolerance** — the dtype the TPU bench advertises "
+               "is accuracy-safe." if bf16.get("within_tolerance")
+               else "**OUT OF TOLERANCE** — investigate before trusting "
+                    "bf16 numbers."),
+        ] if bf16 is not None and "final_auc" in bf16 else []),
         "Full per-round curves: `benchmarks/accuracy_central.json`,",
         "`benchmarks/accuracy_fed.json`, `benchmarks/accuracy_adressa.json`,",
         "`benchmarks/accuracy_finetune.json`.",
@@ -605,12 +711,14 @@ def write_report() -> None:
 # --------------------------------------------------------------------- main
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--leg", choices=["central", "fed", "adressa", "finetune", "report"])
+    p.add_argument("--leg", choices=["central", "fed", "adressa", "finetune",
+                                     "bf16", "report"])
     p.add_argument("--all", action="store_true")
     p.add_argument("--rounds", type=int, default=16)
     p.add_argument("--fed-rounds", type=int, default=10)
     p.add_argument("--adressa-rounds", type=int, default=10)
     p.add_argument("--finetune-rounds", type=int, default=12)
+    p.add_argument("--bf16-rounds", type=int, default=8)
     args = p.parse_args()
 
     if args.all:
@@ -668,10 +776,41 @@ def main() -> int:
             rc = subprocess.run(cmd, env=env, cwd=REPO).returncode
             if rc != 0:
                 return rc
-        return 0
+
+        # dtype-tolerance leg AFTER the report chain: prefer the chip (it
+        # is the dtype's native home) but under the same watchdog + CPU
+        # fallback discipline as the central leg — a post-probe wedge must
+        # not hang --all at the bf16 leg's first compile
+        bf16_cmd = [
+            sys.executable, me, "--leg", "bf16",
+            "--bf16-rounds", str(args.bf16_rounds),
+        ]
+        try:
+            rc = subprocess.run(
+                bf16_cmd, env=env_central, cwd=REPO, timeout=2400
+            ).returncode
+        except subprocess.TimeoutExpired:
+            print("[accuracy] bf16 leg timed out (tunnel wedge?); retrying "
+                  "on CPU", file=sys.stderr)
+            rc = 1
+        if rc != 0 and "FEDREC_ACC_CPU" not in env_central:
+            env_cpu = cpu_host_env()
+            env_cpu["FEDREC_ACC_CPU"] = "1"
+            rc = subprocess.run(
+                bf16_cmd, env=env_cpu, cwd=REPO, timeout=7200
+            ).returncode
+        if rc != 0:
+            return rc
+        # regenerate the report so it includes the bf16 section
+        return subprocess.run(
+            [sys.executable, me, "--leg", "report"],
+            env=dict(os.environ), cwd=REPO,
+        ).returncode
 
     if args.leg == "central":
         leg_central(args.rounds)
+    elif args.leg == "bf16":
+        leg_bf16(args.bf16_rounds)
     elif args.leg == "fed":
         leg_fed(args.rounds)
     elif args.leg == "adressa":

@@ -67,6 +67,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="seconds before a hung DCN collective marks the "
                              "world broken and this host finishes standalone "
                              "(0 = wait forever, the reference's behavior)")
+    parser.add_argument("--resume-local-state", default=None, metavar="PATH",
+                        help="internal: resume standalone from a per-process "
+                             "msgpack state (degraded-mode respawn)")
+    original_argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
 
     from fedrec_tpu.parallel.multihost import (
@@ -80,7 +84,7 @@ def main(argv: list[str] | None = None) -> int:
     import jax
 
     from fedrec_tpu.config import ExperimentConfig
-    from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
+    from fedrec_tpu.data import load_mind_artifacts
     from fedrec_tpu.privacy import calibrate_from_config
     from fedrec_tpu.train.trainer import Trainer
 
@@ -102,10 +106,9 @@ def main(argv: list[str] | None = None) -> int:
     apply_process_sharding(cfg, rt, args.server_trains)
 
     if args.synthetic:
-        data = make_synthetic_mind(
-            num_news=512, num_train=2048, num_valid=256,
-            title_len=cfg.data.max_title_len, popular_frac=0.2,
-        )
+        from fedrec_tpu.cli.run import make_synthetic_from_args
+
+        data = make_synthetic_from_args(args, cfg)
     else:
         data = load_mind_artifacts(args.data_dir)
 
@@ -135,7 +138,10 @@ def main(argv: list[str] | None = None) -> int:
 
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
     local_snap = None
-    if rt.num_processes > 1:
+    # a degraded-mode respawn is a standalone process that must keep the
+    # multi-process msgpack snapshot flavor (it continues ITS shard's run)
+    msgpack_snapshots = rt.num_processes > 1 or args.resume_local_state
+    if msgpack_snapshots:
         # orbax snapshots assume whole-world coordination; in the coordinator
         # deployment each process instead flax-serializes its FULL local
         # state (params + opt state + PRNG) per save cadence, and the server
@@ -166,10 +172,14 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     server_optimizer = None
-    if rt.num_processes > 1:
+    if msgpack_snapshots:
         from flax import serialization
 
-        local_snap = snapshot_dir / f"local_state_p{rt.process_id}.msgpack"
+        local_snap = (
+            Path(args.resume_local_state)
+            if args.resume_local_state
+            else snapshot_dir / f"local_state_p{rt.process_id}.msgpack"
+        )
         if cfg.train.resume and local_snap.exists():
             template = {"state": trainer.state, "round": 0}
             restored = serialization.from_bytes(template, local_snap.read_bytes())
@@ -187,9 +197,11 @@ def main(argv: list[str] | None = None) -> int:
             # therefore never needs to agree across hosts — a client
             # resuming from a stale snapshot cannot desync it. The per-host
             # trainer must not also step its own server optimizer on the
-            # in-process mean (double application).
+            # in-process mean (double application). A degraded-mode respawn
+            # (single process, resume_local_state) is still a CLIENT: it
+            # must not start stepping FedOpt locally either.
             trainer.server_opt = None
-            if rt.is_server:
+            if rt.is_server and rt.num_processes > 1:
                 from fedrec_tpu.fed.strategies import ServerOptimizer
 
                 server_optimizer = ServerOptimizer(
@@ -208,18 +220,75 @@ def main(argv: list[str] | None = None) -> int:
                             "skewed for the first resumed round"
                         )
 
+    def respawn_standalone() -> None:
+        """Degraded CLIENT: leave the broken distributed runtime entirely.
+
+        A degraded client cannot keep living inside the old process. Two
+        failure modes were observed on a 4-process peer-kill run: (1) the
+        XLA coordination client's error poller fatally terminates the
+        process the moment the service (hosted by process 0, itself
+        degraded and exiting) goes away; (2) the watchdog's abandoned
+        collective thread stays blocked inside the runtime and holds its
+        execution lock, so ANY further device op — even serializing state
+        for a snapshot — deadlocks until the broken collective errors
+        out. The only safe move is device-free: exec a standalone
+        continuation of the same command (fresh process, no distributed
+        runtime) that resumes this shard from the last SAVED snapshot.
+        The round in flight when the world broke is simply re-trained
+        standalone. The SERVER owns the coordination service and finishes
+        degraded in-process (finalize's os._exit skips broken teardown).
+        """
+        if rt.is_server or rt.num_processes == 1 or local_snap is None:
+            return
+        import os
+
+        world_flags = {"--coordinator", "--num-processes", "--process-id",
+                       "--collective-timeout", "--resume-local-state"}
+        keep: list[str] = []
+        skip_value = False
+        for tok in original_argv:
+            if skip_value:
+                skip_value = False
+                continue
+            base = tok.split("=", 1)[0]
+            if base in world_flags:
+                skip_value = "=" not in tok
+                continue
+            if base == "--server-trains":
+                continue
+            keep.append(tok)
+        cmd = [
+            sys.executable, "-m", "fedrec_tpu.cli.coordinator", *keep,
+            "--resume-local-state", str(local_snap),
+            "--set", f"data.num_shards={cfg.data.num_shards}",
+            "--set", f"data.shard_index={cfg.data.shard_index}",
+        ]
+        print(
+            f"[coordinator] process {rt.process_id} world degraded — "
+            f"respawning standalone, resuming from "
+            f"{local_snap.name if local_snap.exists() else 'scratch'}",
+            flush=True,
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, cmd)
+
     round_idx = trainer.start_round
     while True:
         # negotiate the round: everyone adopts the SERVER's counter (a host
         # resumed from a stale snapshot would otherwise desync batch seeds,
         # save cadence, and snapshot labels)
         server_round = rt.start_round(round_idx, cfg.fed.rounds)
+        if rt.degraded:
+            respawn_standalone()
         if server_round < 0:
             break
         round_idx = server_round
         # server fan-out: everyone adopts the global model
         u0, n0 = trainer._client0_params()
         u, n = rt.sync_from_server((u0, n0))
+        if rt.degraded:
+            respawn_standalone()
         trainer.set_global_params(u, n)
         round_start_global = (u, n)
 
@@ -242,6 +311,11 @@ def main(argv: list[str] | None = None) -> int:
         u, n = rt.aggregate(
             (u0, n0), participated=trains, weight=w, base=round_start_global
         )
+        if rt.degraded:
+            # device-free exit NOW: the abandoned collective blocks any
+            # further device op (incl. set_global_params below); the round
+            # in flight is re-trained by the standalone continuation
+            respawn_standalone()
         if server_optimizer is not None:
             # server-only (hub-and-spoke): clients adopt the plain mean this
             # round and receive the server's post-opt global at the next
@@ -290,7 +364,9 @@ def main(argv: list[str] | None = None) -> int:
                         snapshot_dir / "server_opt_state.msgpack",
                         server_optimizer.state_bytes(round_idx),
                     )
-                if rt.is_server:
+                if rt.is_server and rt.num_processes > 1:
+                    # a degraded-mode respawn (single process) is a CLIENT
+                    # continuation — its params are NOT the global model
                     atomic_write_bytes(
                         snapshot_dir / f"global_round_{round_idx}.msgpack",
                         serialization.to_bytes(

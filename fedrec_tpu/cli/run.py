@@ -51,9 +51,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", default=None, choices=[None, "joint", "decoupled"])
     p.add_argument("--synthetic", action="store_true",
                    help="use synthetic data instead of --data-dir artifacts")
+    p.add_argument("--synthetic-train", type=int, default=2048,
+                   help="synthetic corpus size (train samples)")
+    p.add_argument("--synthetic-news", type=int, default=512,
+                   help="synthetic corpus size (distinct news)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="SECTION.KEY=VALUE")
     return p
+
+
+def make_synthetic_from_args(args, cfg):
+    """Shared synthetic-corpus construction for the run and coordinator
+    drivers (one definition of the valid-set sizing)."""
+    from fedrec_tpu.data import make_synthetic_mind
+
+    return make_synthetic_mind(
+        num_news=args.synthetic_news, num_train=args.synthetic_train,
+        num_valid=max(args.synthetic_train // 8, 32),
+        title_len=cfg.data.max_title_len, popular_frac=0.2,
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -62,7 +78,7 @@ def main(argv: list[str] | None = None) -> int:
     import jax
 
     from fedrec_tpu.config import ExperimentConfig
-    from fedrec_tpu.data import load_mind_artifacts, make_synthetic_mind
+    from fedrec_tpu.data import load_mind_artifacts
     from fedrec_tpu.privacy import calibrate_from_config
     from fedrec_tpu.train.trainer import Trainer
 
@@ -79,10 +95,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.apply_overrides(args.overrides)
 
     if args.synthetic:
-        data = make_synthetic_mind(
-            num_news=512, num_train=2048, num_valid=256,
-            title_len=cfg.data.max_title_len, popular_frac=0.2,
-        )
+        data = make_synthetic_from_args(args, cfg)
     else:
         data = load_mind_artifacts(args.data_dir)
 

@@ -24,7 +24,9 @@ reference instead hangs until its 2-day gloo timeout if any client dies
 
 from __future__ import annotations
 
+import atexit
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -42,7 +44,22 @@ def initialize_distributed(
 
     All arguments default to cluster auto-detection (TPU pod metadata); set
     them explicitly for manual bring-up, e.g. CPU-based integration tests.
+
+    ``jax_enable_recoverability`` is enabled: without it the coordination
+    service propagates any task failure as fatal to every non-leader.
+    NOTE the remaining platform constraint: the runtime client's error
+    poller still TERMINATES the process (XLA ``client.h:80``) when the
+    coordination service itself goes away (it lives in process 0), and a
+    degraded client's disconnect blocks behind the broken world. Degraded
+    mode in a long-lived deployment must therefore LEAVE the runtime —
+    the coordinator CLI re-execs a degraded client as a standalone
+    continuation from its local snapshot (see
+    ``fedrec_tpu.cli.coordinator``).
     """
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except AttributeError:  # older jax without the flag: keep prior behavior
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -220,6 +237,13 @@ class CoordinatorRuntime:
         self.collective_timeout_s = collective_timeout_s
         self.compress = validate_compress(compress)
         self.degraded = False
+        self._shutdown_done = False
+        if self.num_processes > 1:
+            # registered AFTER jax.distributed.initialize's own atexit hook,
+            # so ours runs FIRST (LIFO): even a driver that never calls
+            # finalize() gets the synchronized teardown below instead of
+            # the destructor race
+            atexit.register(self._synchronized_shutdown)
 
     @property
     def is_server(self) -> bool:
@@ -298,16 +322,60 @@ class CoordinatorRuntime:
             lambda: params,
         )
 
+    def _synchronized_shutdown(self) -> None:
+        """Healthy-world teardown: barrier, clients disconnect, server last.
+
+        ``jax_enable_recoverability`` makes the default shutdown barrier
+        non-blocking for recoverable tasks (the runtime warns exactly
+        this), so without an explicit sync the LEADER can exit and tear
+        down the coordination service while slower peers' disconnect RPCs
+        are still in flight — their C++ client then fatally terminates
+        them at interpreter teardown (observed on a healthy 4-process
+        run). Sequence here: one collective barrier (under the watchdog,
+        so a peer that died right at exit degrades us instead of hanging),
+        then non-server processes disconnect immediately while the server
+        grants a grace period before taking the service down with it.
+        """
+        if self._shutdown_done or self.degraded or self.num_processes == 1:
+            return
+        self._shutdown_done = True
+        if not self.collective_timeout_s:
+            # even without a configured watchdog, the exit barrier must be
+            # BOUNDED: a peer that crashed mid-round (uncaught exception)
+            # would otherwise deadlock this process's interpreter exit
+            self.collective_timeout_s = 60.0
+        self._collective(
+            lambda: multihost_utils.sync_global_devices("fedrec_shutdown"),
+            lambda: None,
+        )
+        if self.degraded:
+            return  # barrier failed; degraded teardown path owns the exit
+        try:
+            if self.is_server:
+                # let every client's disconnect land before the service dies
+                time.sleep(3.0)
+            jax.distributed.shutdown()
+        except Exception as exc:  # noqa: BLE001 — exit must stay clean
+            print(
+                f"[multihost] process {self.process_id}: distributed "
+                f"shutdown raised {exc!r} (ignored)"
+            )
+
     def finalize(self, exit_code: int = 0) -> None:
         """Call after the round loop, once all artifacts are flushed.
 
-        In degraded mode the coordination service is broken: any shutdown
-        barrier — including the one the distributed client's destructor runs
-        at interpreter teardown — either hangs or terminates the process
-        with a fatal coordination-service error. The only clean exit is to
-        skip teardown entirely. No-op while the world is intact.
+        Healthy world: synchronized teardown (see
+        :meth:`_synchronized_shutdown`), then return normally.
+
+        Degraded mode: the coordination service is broken — any shutdown
+        barrier (including the one the distributed client's destructor
+        runs at interpreter teardown) either hangs or terminates the
+        process with a fatal coordination-service error. The only clean
+        exit is to skip teardown entirely via ``os._exit``.
         """
         if not self.degraded:
+            self._synchronized_shutdown()
+        if not self.degraded:  # may have flipped during the shutdown barrier
             return
         import os
         import sys
