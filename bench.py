@@ -259,8 +259,10 @@ def main() -> None:
     # gathers at most B*(C+H)=3,520 slots but holds ~2.4k distinct ids; the
     # cap trims the text tower to 2,560 slots. The math stays exact — the
     # step's own unique_overflow metric is checked before any timing, and a
-    # tripped cap falls back to the uncapped step.
-    flagship_cap = 2560 if on_tpu else 0
+    # tripped cap falls back to the uncapped step. Applied on the CPU
+    # fallback too: identical math, and the text tower dominates there even
+    # harder than on the chip.
+    flagship_cap = 2560
     step_flag, cfg_flag = step, cfg
     if flagship_cap:
         import copy
