@@ -3,6 +3,7 @@ from fedrec_tpu.train.step import (
     build_eval_step,
     build_fed_train_step,
     build_full_eval_step,
+    build_full_eval_step_sharded,
     build_news_update_step,
     build_param_sync,
     encode_all_news,
@@ -13,6 +14,7 @@ __all__ = [
     "ClientState",
     "build_eval_step",
     "build_full_eval_step",
+    "build_full_eval_step_sharded",
     "build_fed_train_step",
     "build_news_update_step",
     "build_param_sync",

@@ -730,16 +730,10 @@ def build_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
     return jax.jit(evaluate)
 
 
-def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
-    """Deterministic FULL-POOL evaluation step.
-
-    ``evaluate(user_params, news_vecs_table, batch) -> dict of (B,) arrays``
-    where ``batch`` holds per-impression ``pos`` (B,), padded negative pools
-    ``neg_pools`` (B, P) with ``neg_mask`` (B, P), and ``history`` (B, H).
-    Scores every real pool negative against the one positive — the protocol
-    behind the reference's published MIND table (``evaluation_split``,
-    reference ``evaluation_functions.py:33-47``), with no sampling noise.
-    """
+def _full_eval_body(model: NewsRecommender) -> Callable:
+    """Per-impression full-pool scoring — the ONE definition both the
+    unsharded and the mesh-sharded eval step wrap (a fix applied to the
+    scoring math can never diverge the two paths)."""
     from fedrec_tpu.eval.metrics import full_pool_metrics_batch
 
     def evaluate(user_params, news_vecs, batch):
@@ -753,4 +747,42 @@ def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Calla
         neg_scores = jnp.einsum("bpd,bd->bp", news_vecs[batch["neg_pools"]], user_vec)
         return full_pool_metrics_batch(pos_scores, neg_scores, batch["neg_mask"])
 
-    return jax.jit(evaluate)
+    return evaluate
+
+
+def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
+    """Deterministic FULL-POOL evaluation step.
+
+    ``evaluate(user_params, news_vecs_table, batch) -> dict of (B,) arrays``
+    where ``batch`` holds per-impression ``pos`` (B,), padded negative pools
+    ``neg_pools`` (B, P) with ``neg_mask`` (B, P), and ``history`` (B, H).
+    Scores every real pool negative against the one positive — the protocol
+    behind the reference's published MIND table (``evaluation_split``,
+    reference ``evaluation_functions.py:33-47``), with no sampling noise.
+    """
+    return jax.jit(_full_eval_body(model))
+
+
+def build_full_eval_step_sharded(
+    model: NewsRecommender, cfg: ExperimentConfig, mesh: Mesh
+) -> Callable:
+    """:func:`build_full_eval_step` sharded over EVERY mesh axis.
+
+    Each of the mesh's devices scores ``B / mesh.size`` impressions against
+    the replicated news-vector table; per-impression metrics come back
+    sharded and the caller's host mean is unchanged. Same per-impression
+    math as the unsharded step (the shard body IS it), so the published-
+    table protocol stays exact while the full-pool pass — the eval
+    bottleneck at MIND scale — takes ``1/mesh.size`` of the wall time.
+    Callers must keep the batch axis divisible by ``mesh.size`` (the
+    Trainer rounds its eval block size accordingly).
+    """
+    axes = tuple(mesh.axis_names)
+    sharded = partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axes)),
+        out_specs=P(axes),
+        check_vma=False,
+    )(_full_eval_body(model))
+    return jax.jit(sharded)

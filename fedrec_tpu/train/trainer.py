@@ -45,6 +45,7 @@ from fedrec_tpu.train.step import (
     build_eval_step,
     build_fed_train_step,
     build_full_eval_step,
+    build_full_eval_step_sharded,
     build_news_update_step,
     build_param_sync,
     encode_all_news,
@@ -167,7 +168,14 @@ class Trainer:
         )
         self.param_sync = build_param_sync(cfg, self.mesh, self.strategy)
         self.eval_step = build_eval_step(self.model, cfg)
-        self.full_eval_step = build_full_eval_step(self.model, cfg)
+        # full-pool eval sharded over the mesh when there is one: same
+        # per-impression math, 1/mesh.size of the eval wall time (the
+        # full-pool pass is the eval bottleneck at MIND scale)
+        self.full_eval_step = (
+            build_full_eval_step_sharded(self.model, cfg, self.mesh)
+            if self.mesh.size > 1
+            else build_full_eval_step(self.model, cfg)
+        )
 
         # state (pre-sharded so the first step doesn't retrace)
         state0 = init_client_state(
@@ -592,6 +600,9 @@ class Trainer:
         mask = (np.arange(P)[None, :] < lens[:, None]).astype(np.float32)
 
         bsz = min(n, 256)
+        if self.mesh.size > 1:
+            # the sharded step splits the batch axis over the mesh evenly
+            bsz = max(self.mesh.size, bsz - bsz % self.mesh.size)
         pad = (-n) % bsz
         def _pad(a):
             return np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) if pad else a

@@ -187,6 +187,29 @@ def test_trainer_evaluate_full_matches_bruteforce(tmp_path):
     assert again == trainer.evaluate_full()
 
 
+def test_full_eval_sharded_matches_unsharded(tmp_path):
+    """Mesh-sharded full-pool eval reproduces the single-device step: the
+    per-impression math is identical, only the batch axis is split over
+    the clients mesh (1/mesh.size of the eval wall time at corpus scale)."""
+    from fedrec_tpu.train.step import build_full_eval_step
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=1)
+    cfg.model.text_encoder_mode = "head"
+    data, token_states = tiny_data(cfg)
+    trainer = Trainer(cfg, data, token_states)
+    assert trainer.mesh.size > 1  # the sharded step must actually be in play
+    got = trainer.evaluate_full()
+    got_last4 = trainer.evaluate_full(last_k=4)
+
+    trainer.full_eval_step = build_full_eval_step(trainer.model, cfg)
+    want = trainer.evaluate_full()
+    want_last4 = trainer.evaluate_full(last_k=4)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-6), k
+        assert got_last4[k] == pytest.approx(want_last4[k], rel=1e-6), k
+
+
 def test_trainer_native_loader_round(tmp_path):
     """Full round with host batches assembled by the C++ engine."""
     from fedrec_tpu.data import native_batcher
