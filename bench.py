@@ -275,7 +275,7 @@ def main() -> None:
         his = r.integers(0, num_news, (1, bsz, H))
         return len(np.unique(np.concatenate([cand.ravel(), his.ravel()])))
 
-    if max(batch_distinct(s, B) for s in range(8)) <= flagship_cap:
+    if flagship_cap and max(batch_distinct(s, B) for s in range(8)) <= flagship_cap:
         cfg_cap = copy.deepcopy(cfg)
         cfg_cap.data.unique_news_cap = flagship_cap
         step_cap = build_fed_train_step(
@@ -296,7 +296,7 @@ def main() -> None:
                 "metric disagree — make_batch/dedup drift; fix bench.py"
             )
         step_flag, cfg_flag = step_cap, cfg_cap
-    else:
+    elif flagship_cap:
         sys.stderr.write(
             f"[bench] unique_news_cap={flagship_cap} would overflow a "
             "bench batch; flagship falls back to the uncapped step\n"

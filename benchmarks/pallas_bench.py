@@ -60,7 +60,13 @@ def _time(fn, *args, iters: int = 30) -> float:
             def body(carry, _):
                 out = fn(first + carry, *rest)
                 z = sum(jnp.sum(l) for l in jax.tree_util.tree_leaves(out))
-                return (z * 0).astype(first.dtype), None
+                # NOT z*0: x*0 is statically zero, so XLA's algebraic
+                # simplifier folds the carry, sees a loop-invariant body,
+                # hoists it out of the scan, and the chain times as ~0 ms
+                # (observed on CPU for grad components). A tiny non-zero
+                # multiplier keeps the data dependency real while leaving
+                # the op's inputs numerically unchanged.
+                return (z.astype(jnp.float32) * 1e-30).astype(first.dtype), None
 
             carry, _ = jax.lax.scan(
                 body, jnp.zeros((), first.dtype), None, length=n

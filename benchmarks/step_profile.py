@@ -26,6 +26,8 @@ from pallas_bench import _time  # noqa: E402  (same honest timer)
 
 
 def main() -> int:
+    import argparse
+
     import jax
     import jax.numpy as jnp
 
@@ -33,12 +35,20 @@ def main() -> int:
     from fedrec_tpu.models import NewsRecommender, score_loss
     from fedrec_tpu.train.step import _batch_news_vecs
 
-    if jax.devices()[0].platform == "cpu":
-        print("needs the TPU (honest timing assumptions)", file=sys.stderr)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu", action="store_true",
+                   help="profile the CPU-fallback step (local timing is "
+                        "trustworthy there; the tunnel caveats are TPU-only)")
+    args = p.parse_args()
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and not args.cpu:
+        print("needs the TPU (honest timing assumptions); pass --cpu to "
+              "profile the CPU-fallback step", file=sys.stderr)
         return 1
 
     cfg = ExperimentConfig()
-    cfg.model.dtype = "bfloat16"
+    cfg.model.dtype = "float32" if on_cpu else "bfloat16"
     num_news, L = 4096, cfg.data.max_title_len
     B, C, H = 64, 1 + cfg.data.npratio, cfg.data.max_his_len
     Dh = cfg.model.bert_hidden
@@ -90,7 +100,10 @@ def main() -> int:
                                  return_inverse=True)
             return model.apply({"params": {"text_head": p}}, ts[uniq],
                                method=NewsRecommender.encode_news).sum()
-        return jax.tree_util.tree_leaves(jax.grad(loss)(text_p))[0].sum()
+        g = jax.grad(loss)(text_p)
+        # sum EVERY leaf: a single bias-grad leaf can be input-independent,
+        # letting XLA fold the whole chained body to a constant (times ~0)
+        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
 
     cand_vecs, his_vecs = _batch_news_vecs(
         model, text_p, token_states, candidates, history
@@ -104,7 +117,8 @@ def main() -> int:
         def loss(p):
             scores = model.apply({"params": {"user_encoder": p}}, cv, his_vecs)
             return score_loss(scores, labels)
-        return jax.tree_util.tree_leaves(jax.grad(loss)(user_p))[0].sum()
+        g = jax.grad(loss)(user_p)
+        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
 
     def full_fwd_bwd(ts):
         def loss(ps):
@@ -112,7 +126,18 @@ def main() -> int:
             scores = model.apply({"params": {"user_encoder": ps["user"]}}, cv, hv)
             return score_loss(scores, labels)
         g = jax.grad(loss)({"text": text_p, "user": user_p})
-        return jax.tree_util.tree_leaves(g)[0].sum()
+        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+    def full_fwd_bwd_capped(ts):
+        # the FLAGSHIP configuration: unique-news cap 2560 (bench.py)
+        def loss(ps):
+            cv, hv = _batch_news_vecs(
+                model, ps["text"], ts, candidates, history, cap=2560
+            )
+            scores = model.apply({"params": {"user_encoder": ps["user"]}}, cv, hv)
+            return score_loss(scores, labels)
+        g = jax.grad(loss)({"text": text_p, "user": user_p})
+        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
 
     comps = {
         "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
@@ -122,17 +147,22 @@ def main() -> int:
         "user_fwd": (user_fwd, cand_vecs),
         "user_fwd_bwd": (user_fwd_bwd, cand_vecs),
         "full_fwd_bwd": (full_fwd_bwd, token_states),
+        "full_fwd_bwd_capped": (full_fwd_bwd_capped, token_states),
     }
     out = {}
     for name, (fn, arg0) in comps.items():
-        t = _time(jax.jit(fn), arg0)
+        t = _time(jax.jit(fn), arg0, iters=3 if on_cpu else 30)
         out[name] = round(t * 1e3, 4)
-        print(f"{name:16s} {t*1e3:8.3f} ms", flush=True)
+        print(f"{name:20s} {t*1e3:9.3f} ms", flush=True)
 
     from fedrec_tpu.utils.provenance import provenance
 
-    Path(__file__).with_name("step_profile.json").write_text(
-        json.dumps({"B": B, "components_ms": out,
+    # CPU profiles land in their own artifact so a future chip run never
+    # gets shadowed (and vice versa)
+    name = "step_profile_cpu.json" if on_cpu else "step_profile.json"
+    Path(__file__).with_name(name).write_text(
+        json.dumps({"B": B, "dtype": cfg.model.dtype,
+                    "components_ms": out,
                     "provenance": provenance()}, indent=2)
     )
     return 0
