@@ -37,6 +37,7 @@ WORKER = textwrap.dedent(
         sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4],
         int(sys.argv[5]),
     )
+    save_every = sys.argv[6] if len(sys.argv) > 6 else "1"
     if die_at >= 0:
         # deterministic mid-round crash: this peer dies INSIDE round
         # `die_at`'s local training, before its aggregate contribution
@@ -53,7 +54,7 @@ WORKER = textwrap.dedent(
         trainer_mod.Trainer.train_round = dying
     from fedrec_tpu.cli.coordinator import main
     sys.exit(main([
-        rounds, "8", "1",
+        rounds, "8", save_every,
         "--coordinator", f"127.0.0.1:{port}",
         "--num-processes", "4", "--process-id", str(pid),
         "--synthetic", "--synthetic-train", "640", "--synthetic-news", "128",
@@ -80,7 +81,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(tmp_path, dirs, rounds: int, die_pid: int = -1, die_at: int = -1):
+def _launch(tmp_path, dirs, rounds: int, die_pid: int = -1, die_at: int = -1,
+            save_every: int = 1):
     port = _free_port()
     script = tmp_path / "adversarial_worker.py"
     script.write_text(WORKER)
@@ -90,7 +92,8 @@ def _launch(tmp_path, dirs, rounds: int, die_pid: int = -1, die_at: int = -1):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(port), str(pid), str(dirs[pid]),
-             str(rounds), str(die_at if pid == die_pid else -1)],
+             str(rounds), str(die_at if pid == die_pid else -1),
+             str(save_every)],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
@@ -165,6 +168,25 @@ def test_adversarial_kill_survivors_progress(tmp_path):
             # standalone continuation (the server finishes in-process)
             assert "respawning standalone" in out
             assert "resumed local state" in out
+
+
+def test_adversarial_kill_before_first_snapshot(tmp_path):
+    """Respawn's from-scratch branch: with save_every beyond the crash
+    round NO local snapshot exists when the world breaks — the degraded
+    client must still leave the runtime and redo its shard's rounds
+    standalone from initialization."""
+    d_dirs = [tmp_path / f"d{i}" for i in range(N_PROC)]
+    procs, outs = _launch(
+        tmp_path, d_dirs, rounds=3, die_pid=3, die_at=1, save_every=5
+    )
+    assert procs[3].returncode == 1 and "PEER_DYING" in outs[3]
+    for pid in range(3):
+        out = outs[pid]
+        assert procs[pid].returncode == 0, f"D proc {pid} failed:\n{out[-3000:]}"
+        assert "done after 3 rounds" in out
+    for pid in (1, 2):
+        assert "respawning standalone, resuming from scratch" in outs[pid]
+        assert "resumed local state" not in outs[pid]
         losses = _round_losses(out)
         assert len(losses) >= 4, f"survivor {pid} logged {len(losses)} rounds"
         # loss decreases across the standalone rounds (and overall)
