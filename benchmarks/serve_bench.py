@@ -1,0 +1,116 @@
+"""Serving-path throughput: users/sec for full-catalog top-k scoring.
+
+The serving subsystem (``fedrec_tpu.serve``, beyond-parity: the reference
+stops at validation, reference ``client.py:149-171``) had tests but no perf
+artifact. This measures the jitted ``recommend`` program — user encode over
+the history, one (B, D) x (D, N) full-catalog matmul, masked ``top_k`` — at
+MIND-small catalog scale (N=65k news, D=400) across user-batch sizes.
+
+On TPU the tunnel-honest chain timer applies (``pallas_bench._time``); on
+CPU plain local timing is trustworthy, and the number contextualizes the
+CPU-fallback deployment. Writes ``benchmarks/serve_bench[_cpu].json``.
+
+Usage: python benchmarks/serve_bench.py [--cpu] [--num-news 65000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pallas_bench import _time  # noqa: E402  (same honest timer on TPU)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu", action="store_true",
+                   help="allow running on the CPU backend (local timing)")
+    p.add_argument("--num-news", type=int, default=65_000)  # MIND-small scale
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--his-len", type=int, default=50)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serve import build_recommend_fn
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and not args.cpu:
+        print("needs the TPU (honest timing assumptions); pass --cpu for a "
+              "local CPU measurement", file=sys.stderr)
+        return 1
+
+    cfg = ExperimentConfig()
+    cfg.model.dtype = "float32" if on_cpu else "bfloat16"
+    N, D, H = args.num_news, cfg.model.news_dim, args.his_len
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.standard_normal((N, D)), dtype=jnp.dtype(cfg.model.dtype)
+    )
+    model = NewsRecommender(cfg.model)
+    dummy = jnp.zeros((1, H, D), jnp.dtype(cfg.model.dtype))
+    user_params = model.init(
+        jax.random.PRNGKey(0), dummy, method=NewsRecommender.encode_user
+    )["params"]["user_encoder"]
+    fn = build_recommend_fn(model, top_k=args.top_k)
+    jfn = jax.jit(fn)
+
+    out_rows = {}
+    for B in (1, 64, 256, 1024):
+        history = jnp.asarray(
+            rng.integers(1, N, (B, H)).astype(np.int32)
+        )
+        if on_cpu:
+            # plain local timing: warm, then best-of-3 with host sync
+            np.asarray(jfn(user_params, table, history)[0])
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(jfn(user_params, table, history)[0])
+                best = min(best, time.perf_counter() - t0)
+            dt = best
+        else:
+            # the chain timer perturbs the FIRST argument; wrap so that is
+            # the float table (histories stay fixed ids)
+            dt = _time(
+                jax.jit(lambda t, h: fn(user_params, t, h)[1]),
+                table, history,
+            )
+        out_rows[str(B)] = {
+            "users_per_sec": round(B / dt, 2),
+            "ms_per_batch": round(dt * 1e3, 3),
+        }
+        print(f"B={B:5d}  {B/dt:12.1f} users/s  ({dt*1e3:.3f} ms)", flush=True)
+
+    from fedrec_tpu.utils.provenance import provenance
+
+    name = "serve_bench_cpu.json" if on_cpu else "serve_bench.json"
+    Path(__file__).with_name(name).write_text(json.dumps({
+        "metric": "recommend_throughput",
+        "unit": "users/sec",
+        "num_news": N,
+        "news_dim": D,
+        "top_k": args.top_k,
+        "his_len": H,
+        "dtype": cfg.model.dtype,
+        "batches": out_rows,
+        "provenance": provenance(),
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,7 +24,6 @@ softmax, additive -1e9 key bias for the mask, fully-masked rows return 0
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -168,6 +168,11 @@ def test_adversarial_kill_survivors_progress(tmp_path):
             # standalone continuation (the server finishes in-process)
             assert "respawning standalone" in out
             assert "resumed local state" in out
+        losses = _round_losses(out)
+        assert len(losses) >= 4, f"survivor {pid} logged {len(losses)} rounds"
+        # loss decreases across the standalone rounds (and overall)
+        assert losses[-1] < losses[0], (pid, losses)
+        assert losses[-1] < losses[1], (pid, losses)
 
 
 def test_adversarial_kill_before_first_snapshot(tmp_path):
@@ -187,8 +192,7 @@ def test_adversarial_kill_before_first_snapshot(tmp_path):
     for pid in (1, 2):
         assert "respawning standalone, resuming from scratch" in outs[pid]
         assert "resumed local state" not in outs[pid]
-        losses = _round_losses(out)
-        assert len(losses) >= 4, f"survivor {pid} logged {len(losses)} rounds"
-        # loss decreases across the standalone rounds (and overall)
+        # from-scratch redo: rounds 0..2 all retrained standalone
+        losses = _round_losses(outs[pid])
+        assert len(losses) >= 3, f"survivor {pid} logged {len(losses)} rounds"
         assert losses[-1] < losses[0], (pid, losses)
-        assert losses[-1] < losses[1], (pid, losses)
