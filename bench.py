@@ -159,6 +159,13 @@ def main() -> None:
                     sys.stderr.write(proc.stderr[-2000:] + "\n")
             except subprocess.TimeoutExpired:
                 sys.stderr.write("[bench] tpu run timed out; cpu fallback\n")
+        else:
+            # say so explicitly: a silent fall-through here is
+            # indistinguishable from "probe never attempted" in the logs
+            sys.stderr.write(
+                "[bench] accelerator probe failed (no backend, or device "
+                "query ok but compile wedged); cpu fallback\n"
+            )
         _reexec("cpu")
 
     import jax
@@ -341,6 +348,20 @@ def main() -> None:
             "real-chip measurement of this code (bench.py measure()), with "
             "the fresh CPU-fallback run nested under cpu_fallback_now"
         )
+        # let the reader check staleness at a glance: does the cached chip
+        # measurement describe the tree being benched right now? (claimed
+        # only for a CLEAN checkout at the measured commit)
+        from fedrec_tpu.utils.provenance import git_dirty, git_head
+
+        head = git_head(Path(__file__).parent)
+        if head != "unknown":
+            dirty = git_dirty(Path(__file__).parent)
+            suffix = {True: "-dirty", False: "", None: "-unknown"}[dirty]
+            cached["bench_tree_commit"] = head + suffix
+            mc = str(cached.get("measured_commit", "")).split()
+            cached["cache_is_current_tree"] = (
+                bool(mc) and head[:7] == mc[0][:7] and dirty is False
+            )
         cached["cpu_fallback_now"] = out
         print(json.dumps(cached))
         return

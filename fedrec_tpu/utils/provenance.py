@@ -26,6 +26,26 @@ def git_head(repo: Path | None = None) -> str:
         return "unknown"
 
 
+def git_dirty(repo: Path | None = None) -> bool | None:
+    """True when TRACKED files have uncommitted changes, None if unknown.
+
+    Untracked scratch files deliberately don't count: the caller's question
+    is "does the checkout still match the stamped commit's code", and a
+    stray notes file answers nothing about that.
+    """
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            capture_output=True, text=True, timeout=10,
+            cwd=repo or Path(__file__).resolve().parents[2],
+        )
+        if out.returncode != 0:
+            return None
+        return bool(out.stdout.strip())
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def provenance(**extra) -> dict:
     """Stamp: commit, wall time, machine, CPU count, and the JAX backend
     actually in use (when JAX is already imported — never imports it)."""

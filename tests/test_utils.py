@@ -84,3 +84,46 @@ def test_cpu_host_env_recipe():
     assert fake_device_count({"XLA_FLAGS": "--nope"}) is None
     # pure function: the base mapping is never mutated
     assert base["JAX_PLATFORMS"] == "axon" and "PALLAS_AXON_POOL_IPS" in base
+
+
+def test_git_provenance_helpers(tmp_path):
+    """`git_head`/`git_dirty` report a real checkout honestly and degrade to
+    their unknown sentinels outside one (bench.py's cached-result staleness
+    flag is built on exactly these two answers)."""
+    import subprocess
+
+    from fedrec_tpu.utils.provenance import git_dirty, git_head
+
+    # this repo: a short hex head; dirty is a definite bool
+    head = git_head()
+    assert head != "unknown" and all(c in "0123456789abcdef" for c in head)
+    assert git_dirty() in (True, False)
+
+    # a fresh repo with one commit: clean, then dirty after a TRACKED edit
+    # (hermetic: the user's global/system git config must not leak in —
+    # e.g. commit.gpgsign=true would fail the commit)
+    import os
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ,
+               GIT_CONFIG_GLOBAL="/dev/null", GIT_CONFIG_SYSTEM="/dev/null")
+    run = lambda *a: subprocess.run(  # noqa: E731
+        a, cwd=repo, capture_output=True, text=True, check=True, env=env
+    )
+    run("git", "init", "-q")
+    (repo / "f").write_text("x")
+    run("git", "add", "f")
+    run("git", "-c", "user.email=t@t", "-c", "user.name=t",
+        "commit", "-q", "-m", "x")
+    assert git_dirty(repo) is False
+    (repo / "untracked").write_text("x")
+    assert git_dirty(repo) is False  # untracked scratch files don't count
+    (repo / "f").write_text("y")
+    assert git_dirty(repo) is True
+
+    # not a repo at all -> sentinels, no raise
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    assert git_head(bare) == "unknown"
+    assert git_dirty(bare) is None
