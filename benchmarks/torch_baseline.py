@@ -75,7 +75,7 @@ class UserEncoderT(nn.Module):
 
 
 def run(batch_size=64, cand=5, his_len=50, title_len=50, num_news=4096,
-        warmup=1, iters=3, seed=0):
+        warmup=1, iters=3, seed=0, dedup=False):
     torch.manual_seed(seed)
     rng = np.random.default_rng(seed)
     states_table = torch.randn(num_news, title_len, 768)
@@ -88,7 +88,16 @@ def run(batch_size=64, cand=5, his_len=50, title_len=50, num_news=4096,
         cand_ids = torch.from_numpy(rng.integers(0, num_news, (batch_size, cand)))
         his_ids = torch.from_numpy(rng.integers(0, num_news, (batch_size, his_len)))
         ids = torch.cat([cand_ids.reshape(-1), his_ids.reshape(-1)])
-        vecs = head(states_table[ids])  # (B*(C+H), 400) — no dedup, like the reference
+        if dedup:
+            # the best-reasonable-torch variant at large B: encode each
+            # distinct news once and index back (at B=1024 the no-dedup
+            # gather is 56k slots over a 4k-news table — 13.7x redundant
+            # text-tower work no competent implementation would do). Our
+            # TPU step dedups in-program, so the sweep measures both.
+            uniq, inv = torch.unique(ids, return_inverse=True)
+            vecs = head(states_table[uniq])[inv]
+        else:
+            vecs = head(states_table[ids])  # no dedup, like the reference
         cand_vecs = vecs[: batch_size * cand].view(batch_size, cand, -1)
         his_vecs = vecs[batch_size * cand:].view(batch_size, his_len, -1)
         user_vec = user(his_vecs)
@@ -126,6 +135,23 @@ if __name__ == "__main__":
     from fedrec_tpu.utils.provenance import provenance
 
     result = run()
+    # per-B sweep: bench.py's promoted headline divides by the baseline's
+    # BEST measured rate over this sweep (not the B=64 row), so the
+    # cross-platform ratio never leans on an unmeasured "torch is
+    # batch-size-invariant" assumption
+    sweep = {"64": result["samples_per_sec"]}
+    for bsz in (256, 1024):
+        r = run(batch_size=bsz, iters=2)
+        sweep[str(bsz)] = r["samples_per_sec"]
+    # dedup'd rows: the best-reasonable-torch variant (see run(dedup=True));
+    # bench.py divides by the max over ALL rows, so granting the baseline
+    # this optimization can only shrink our advertised ratio
+    for bsz in (64, 256, 1024):
+        r = run(batch_size=bsz, iters=2, dedup=True)
+        sweep[f"{bsz}_dedup"] = r["samples_per_sec"]
+    result["b_sweep_samples_per_sec"] = {
+        k: round(v, 2) for k, v in sweep.items()
+    }
     result["provenance"] = provenance()
     out = Path(__file__).parent / "baseline_host.json"
     out.write_text(json.dumps(result, indent=2))
