@@ -413,13 +413,32 @@ def main() -> None:
             # its real provenance (wall time + code revision measured).
             # Called after EVERY metric lands so a bonus-metric failure (or
             # a tunnel wedge mid-bonus) can never discard what's measured.
+            #
+            # Clobber guard: a RETRIED run's first stamps are sparse (the
+            # B=64 primary only). When the incumbent artifact is for the
+            # SAME commit and already holds the B sweep, the sparse rerun
+            # stages into *.inprogress.json instead — it promotes onto the
+            # real path the moment it regains the sweep. A different-commit
+            # incumbent is always overwritten: fresh evidence for the
+            # current tree beats rich evidence for an older one.
             from fedrec_tpu.utils.provenance import provenance
 
             stamp = provenance()
             out["measured_at"] = stamp["measured_at"]
             out["measured_commit"] = stamp["commit"]
             out["provenance"] = stamp
-            cache_path.write_text(json.dumps(out, indent=2))
+            target = cache_path
+            if "b_sweep_samples_per_sec" not in out and cache_path.exists():
+                try:
+                    incumbent = json.loads(cache_path.read_text())
+                    if (
+                        incumbent.get("measured_commit") == stamp["commit"]
+                        and "b_sweep_samples_per_sec" in incumbent
+                    ):
+                        target = cache_path.with_suffix(".inprogress.json")
+                except Exception:  # noqa: BLE001 — unreadable incumbent
+                    pass
+            target.write_text(json.dumps(out, indent=2))
 
         stamp_and_cache()  # the B=64 primary is in the bank
 

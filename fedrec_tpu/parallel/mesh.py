@@ -30,8 +30,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CLIENT_AXIS = "clients"
 
 
-def client_mesh(num_clients: int, axis: str = CLIENT_AXIS, local: bool = True) -> Mesh:
-    """1-D mesh with one slot per federated client.
+def client_mesh(
+    num_clients: int,
+    axis: str = CLIENT_AXIS,
+    local: bool = True,
+    max_devices: int | None = None,
+) -> Mesh:
+    """1-D mesh over the federated-client axis.
 
     ``local=True`` (default) builds the mesh from this process's addressable
     devices — correct for single-host simulation and for the coordinator
@@ -39,17 +44,35 @@ def client_mesh(num_clients: int, axis: str = CLIENT_AXIS, local: bool = True) -
     ``local=False`` uses the global device list for a single-controller
     multi-host SPMD mesh (all hosts must then feed globally-sharded arrays).
 
-    Requires ``num_clients`` <= available devices; on CPU test rigs use
-    ``--xla_force_host_platform_device_count``.
+    When ``num_clients`` exceeds the device count, the mesh spans every
+    device and each device hosts a COHORT of ``num_clients / n_devices``
+    clients (the train/sync steps vmap over the in-device cohort and run
+    collectives over ``(cohort, mesh)`` jointly — see
+    ``fedrec_tpu.train.step.LOCAL_AXIS``). This is how a 32-client
+    federation (BASELINE.json north star) runs on fewer chips, the
+    TPU-native analogue of oversubscribing torchrun ranks onto one node
+    (reference ``README.md:27-34``). Requires divisibility; on CPU test
+    rigs use ``--xla_force_host_platform_device_count``.
+
+    ``max_devices`` caps the device pool (mainly for equivalence tests:
+    the same client count with different cohort factors).
     """
     devices = jax.local_devices() if local else jax.devices()
-    if num_clients > len(devices):
+    if max_devices is not None:
+        devices = devices[:max_devices]
+    if num_clients <= len(devices):
+        size = num_clients
+    elif num_clients % len(devices) == 0:
+        size = len(devices)
+    else:
         raise ValueError(
-            f"num_clients={num_clients} exceeds {len(devices)} available devices; "
-            "set XLA_FLAGS=--xla_force_host_platform_device_count for simulation"
+            f"num_clients={num_clients} exceeds {len(devices)} available "
+            "devices and is not divisible by the device count (cohort "
+            "sharding needs equal cohorts); set XLA_FLAGS="
+            "--xla_force_host_platform_device_count for simulation"
         )
     mesh_devices = mesh_utils.create_device_mesh(
-        (num_clients,), devices=devices[:num_clients]
+        (size,), devices=devices[:size]
     )
     return Mesh(mesh_devices, (axis,))
 
@@ -69,14 +92,25 @@ def fed_mesh(cfg: Any, local: bool = True) -> Mesh:
             f"fed.seq_shards={n_seq} to shard the history axis"
         )
     devices = jax.local_devices() if local else jax.devices()
-    need = n_cli * n_seq
-    if need > len(devices):
+    cli_slots = len(devices) // n_seq
+    if cli_slots < 1:
         raise ValueError(
-            f"num_clients*seq_shards={need} exceeds {len(devices)} devices; "
+            f"fed.seq_shards={n_seq} exceeds {len(devices)} devices; "
             "set XLA_FLAGS=--xla_force_host_platform_device_count for simulation"
         )
+    if n_cli <= cli_slots:
+        size = n_cli
+    elif n_cli % cli_slots == 0:
+        size = cli_slots  # cohorts: size*n_seq devices, n_cli/size per slot
+    else:
+        raise ValueError(
+            f"num_clients={n_cli} exceeds the {cli_slots} client slots of a "
+            f"{len(devices)}-device mesh with seq_shards={n_seq} and is not "
+            "divisible by the slot count (cohort sharding needs equal "
+            "cohorts); set XLA_FLAGS=--xla_force_host_platform_device_count"
+        )
     mesh_devices = mesh_utils.create_device_mesh(
-        (n_cli, n_seq), devices=devices[:need]
+        (size, n_seq), devices=devices[: size * n_seq]
     )
     return Mesh(mesh_devices, (cfg.fed.mesh_axis, cfg.fed.seq_axis))
 

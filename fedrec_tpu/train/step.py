@@ -82,6 +82,59 @@ def _restack(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: x[None], tree)
 
 
+# vmap axis name for the in-device client cohort (num_clients > devices):
+# cross-client collectives then run over (LOCAL_AXIS, mesh_axis) jointly, so
+# "average over all clients" means exactly that regardless of how clients
+# map onto chips. The TPU-native analogue of oversubscribing torchrun ranks
+# onto one node (reference README.md:27-34 runs N ranks on localhost).
+LOCAL_AXIS = "local_clients"
+
+
+def clients_per_device(cfg: ExperimentConfig, mesh: Mesh) -> int:
+    """Cohort size: how many of ``fed.num_clients`` live on each mesh slot.
+
+    1 == the classic one-client-per-chip layout. >1 requires equal cohorts
+    (enforced here; ``parallel.mesh.client_mesh`` builds such meshes when
+    clients outnumber devices).
+    """
+    m = int(mesh.shape[cfg.fed.mesh_axis])
+    n = cfg.fed.num_clients
+    if n % m != 0:
+        raise ValueError(
+            f"fed.num_clients={n} is not divisible by the mesh's "
+            f"{cfg.fed.mesh_axis!r} axis size {m}; cohort sharding needs "
+            "equal cohorts per device"
+        )
+    return n // m
+
+
+def cohort_axes(cfg: ExperimentConfig, mesh: Mesh) -> tuple[int, Any]:
+    """(cohort size k, the axes every cross-client collective must span).
+
+    The ONE definition of the cohort-axes policy — all step builders use it,
+    so "average over all clients" can never mean different things in
+    different parts of a round.
+    """
+    k = clients_per_device(cfg, mesh)
+    axis = cfg.fed.mesh_axis
+    return k, (axis if k == 1 else (LOCAL_AXIS, axis))
+
+
+def _cohort_call(local_fn: Callable, k: int, n_args_mapped: int, *args):
+    """Run ``local_fn`` on a shard_map block: squeeze for k==1, vmap the
+    in-device cohort (axis name LOCAL_AXIS) for k>1.
+
+    ``n_args_mapped``: how many leading args carry the per-client block dim
+    (the rest — feature tables — are replicated/unmapped).
+    """
+    if k == 1:
+        out = local_fn(*(_unstack(a) for a in args[:n_args_mapped]),
+                       *args[n_args_mapped:])
+        return _restack(out)
+    in_axes = (0,) * n_args_mapped + (None,) * (len(args) - n_args_mapped)
+    return jax.vmap(local_fn, in_axes=in_axes, axis_name=LOCAL_AXIS)(*args)
+
+
 def _batch_news_vecs(
     model: NewsRecommender,
     news_params: Any,
@@ -342,6 +395,11 @@ def build_fed_train_step(
         text_encoder = make_text_encoder(cfg.model)
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
     axis = cfg.fed.mesh_axis
+    # in-device client cohorts (num_clients > mesh slots): the local block
+    # carries k clients, vmapped under LOCAL_AXIS; every cross-client
+    # collective then spans (LOCAL_AXIS, mesh axis) so federation semantics
+    # are independent of the client->chip packing
+    k, sync_axes = cohort_axes(cfg, mesh)
     # sequence parallelism: history sharded over a second mesh axis, user
     # tower attends via ring/Ulysses collectives (fedrec_tpu.parallel.ring)
     n_seq = cfg.fed.seq_shards
@@ -486,8 +544,8 @@ def build_fed_train_step(
                     )
             if noise_fn is not None:
                 user_g, news_g = noise_fn((user_g, news_g), noise_rng)
-            user_g = strategy.sync_grads(user_g, axis)
-            news_g = strategy.sync_grads(news_g, axis)
+            user_g = strategy.sync_grads(user_g, sync_axes)
+            news_g = strategy.sync_grads(news_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
             n_updates, opt_news = opt_news_tx.update(news_g, state.opt_news, state.news_params)
             new_state = state.replace(
@@ -536,7 +594,7 @@ def build_fed_train_step(
             )
             accum = state.news_grad_accum.at[ids].add(grads_flat)
 
-            user_g = strategy.sync_grads(user_g, axis)
+            user_g = strategy.sync_grads(user_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
             new_state = state.replace(
                 step=state.step + 1,
@@ -550,7 +608,7 @@ def build_fed_train_step(
         else:
             raise ValueError(f"unknown step mode {mode!r}")
 
-        mean_loss = lax.pmean(loss, axis_name=axis)
+        mean_loss = lax.pmean(loss, axis_name=sync_axes)
         metrics = {"loss": loss, "mean_loss": mean_loss}
         capped = (
             cfg.data.unique_news_cap
@@ -573,7 +631,7 @@ def build_fed_train_step(
                 # (check_vma=False) would report only seq-shard 0's flag and
                 # silently swallow corruption on the others
                 flag = lax.psum(flag, seq_ax)
-            metrics["unique_overflow"] = lax.psum(flag, axis_name=axis)
+            metrics["unique_overflow"] = lax.psum(flag, axis_name=sync_axes)
         return new_state, metrics
 
     if n_seq > 1:
@@ -595,10 +653,7 @@ def build_fed_train_step(
         check_vma=False,
     )
     def sharded_step(stacked_state, batch, table):
-        state = _unstack(stacked_state)
-        local_batch = _unstack(batch)
-        new_state, metrics = local_step(state, local_batch, table)
-        return _restack(new_state), _restack(metrics)
+        return _cohort_call(local_step, k, 2, stacked_state, batch, table)
 
     return jax.jit(sharded_step, donate_argnums=(0,))
 
@@ -628,6 +683,7 @@ def build_news_update_step(
     _, opt_news_tx = make_optimizers(cfg)
     axis = cfg.fed.mesh_axis
     strategy = strategy or FedStrategy()
+    k, sync_axes = cohort_axes(cfg, mesh)
 
     def local_update(state: ClientState, token_states: jnp.ndarray):
         def encode(news_params):
@@ -635,7 +691,7 @@ def build_news_update_step(
 
         vecs, vjp = jax.vjp(encode, state.news_params)
         (head_g,) = vjp(state.news_grad_accum)
-        head_g = strategy.sync_grads(head_g, axis)
+        head_g = strategy.sync_grads(head_g, sync_axes)
         n_updates, opt_news = opt_news_tx.update(
             head_g, state.opt_news, state.news_params
         )
@@ -658,9 +714,7 @@ def build_news_update_step(
         check_vma=False,
     )
     def sharded_update(stacked_state, token_states):
-        state = _unstack(stacked_state)
-        new_state, vecs = local_update(state, token_states)
-        return _restack(new_state), _restack(vecs)
+        return _cohort_call(local_update, k, 1, stacked_state, token_states)
 
     return jax.jit(sharded_update, donate_argnums=(0,))
 
@@ -679,6 +733,12 @@ def build_param_sync(
     """
     axis = cfg.fed.mesh_axis
     strategy = strategy or ParamAvg()
+    k, sync_axes = cohort_axes(cfg, mesh)
+
+    def local_sync(state: ClientState, w: jnp.ndarray):
+        new_user = strategy.sync_params(state.user_params, w, sync_axes)
+        new_news = strategy.sync_params(state.news_params, w, sync_axes)
+        return state.replace(user_params=new_user, news_params=new_news)
 
     @partial(
         shard_map,
@@ -688,11 +748,7 @@ def build_param_sync(
         check_vma=False,
     )
     def sharded_sync(stacked_state, weights):
-        state = _unstack(stacked_state)
-        w = weights[0]
-        new_user = strategy.sync_params(state.user_params, w, axis)
-        new_news = strategy.sync_params(state.news_params, w, axis)
-        return _restack(state.replace(user_params=new_user, news_params=new_news))
+        return _cohort_call(local_sync, k, 2, stacked_state, weights)
 
     # NOT donated (unlike the train step): sync runs once per round, so the
     # transient double-buffer is cheap, and callers legitimately hold the

@@ -1,0 +1,258 @@
+"""Client cohorts: MORE federated clients than mesh devices.
+
+The reference oversubscribes torchrun ranks onto one node (reference
+``README.md:27-34`` — N gloo ranks on localhost); the TPU-native analogue
+packs ``k = num_clients / n_devices`` clients per chip: the shard_map block
+carries a cohort, the step vmaps over it under ``LOCAL_AXIS``, and every
+cross-client collective spans ``(LOCAL_AXIS, mesh_axis)`` jointly. These
+tests pin the load-bearing property: federation semantics are INDEPENDENT of
+the client->chip packing — the same 8 clients on 8 devices (k=1) and on 4
+devices (k=2) produce the same training trajectory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedrec_tpu.fed import get_strategy
+from fedrec_tpu.parallel import client_mesh, shard_batch
+from fedrec_tpu.train import (
+    build_fed_train_step,
+    build_news_update_step,
+    build_param_sync,
+    encode_all_news,
+)
+from fedrec_tpu.train.step import clients_per_device
+from fedrec_tpu.train.state import init_client_state, replicate_state
+
+from test_train import make_setup, small_cfg, _batch_dict
+
+
+def _run_steps(cfg, mesh, strategy_name, mode, n_steps=3, seed=0):
+    """Deterministic short training run; returns (stacked_state, losses)."""
+    data, batcher, token_states, model, stacked, _ = make_setup(cfg, seed=seed)
+    if mode == "decoupled":
+        p0 = jax.tree_util.tree_map(lambda x: x[0], stacked.news_params)
+        table = encode_all_news(model, p0, token_states)
+    else:
+        table = token_states
+    step = build_fed_train_step(model, cfg, get_strategy(strategy_name), mesh, mode=mode)
+    losses, done = [], 0
+    for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, 0):
+        stacked, metrics = step(stacked, shard_batch(mesh, _batch_dict(b)), table)
+        losses.append(float(np.mean(np.asarray(metrics["mean_loss"]))))
+        done += 1
+        if done >= n_steps:
+            break
+    return stacked, losses, model, token_states
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def test_cohort_mesh_and_k():
+    mesh = client_mesh(16)  # 16 clients on the 8-device rig -> k=2
+    cfg = small_cfg(fed__num_clients=16)
+    assert int(mesh.shape[cfg.fed.mesh_axis]) == 8
+    assert clients_per_device(cfg, mesh) == 2
+
+
+def test_cohort_requires_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        client_mesh(12, max_devices=8)  # 12 clients, 8 devices
+    cfg = small_cfg(fed__num_clients=6)
+    mesh = client_mesh(4, max_devices=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        clients_per_device(cfg, mesh)
+
+
+def test_cohort_sync_grads_is_exactly_the_global_mean():
+    """The load-bearing collective: GradAvg.sync_grads over
+    ``(LOCAL_AXIS, mesh_axis)`` equals the numpy mean over ALL clients,
+    for every client, regardless of packing."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from fedrec_tpu.fed.strategies import GradAvg
+    from fedrec_tpu.train.step import LOCAL_AXIS
+
+    axis = small_cfg().fed.mesh_axis
+    vals = np.arange(8 * 3, dtype=np.float32).reshape(8, 3) ** 1.5  # distinct
+    for max_dev, k in ((8, 1), (4, 2), (2, 4)):
+        mesh = client_mesh(8, max_devices=max_dev)
+        sync_axes = axis if k == 1 else (LOCAL_AXIS, axis)
+
+        def local(x):
+            return GradAvg().sync_grads(x, sync_axes)
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+        def run(stacked):
+            if k == 1:
+                return local(stacked[0])[None]
+            return jax.vmap(local, axis_name=LOCAL_AXIS)(stacked)
+
+        out = np.asarray(run(shard_batch(mesh, vals)))
+        expect = vals.mean(axis=0)
+        for c in range(8):
+            np.testing.assert_allclose(out[c], expect, rtol=1e-6)
+
+
+def test_cohort_grad_avg_matches_one_client_per_device():
+    """8 clients on 4 devices (k=2) == 8 clients on 8 devices (k=1):
+    identical per-step mean-loss trajectory on identical data.
+
+    Only losses are compared: final PARAMS are ill-conditioned for exact
+    comparison — on near-zero-gradient leaves Adam's update is
+    ~lr*sign(g), so the f32 reduction-order epsilon between the flat pmean
+    (k=1) and the hierarchical vmap-mean+pmean (k=2) can flip a whole
+    lr-sized step. The collective's exactness is pinned directly by
+    test_cohort_sync_grads_is_exactly_the_global_mean and
+    test_cohort_weighted_param_sync_exact; in-cohort identity by the
+    lockstep test below.
+    """
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    _, losses1, _, _ = _run_steps(cfg, client_mesh(8), "grad_avg", "joint")
+    _, losses2, _, _ = _run_steps(
+        cfg, client_mesh(8, max_devices=4), "grad_avg", "joint"
+    )
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-5)
+
+
+def test_cohort_grad_avg_lockstep_within_and_across_devices():
+    cfg = small_cfg(fed__num_clients=8)
+    st, _, _, _ = _run_steps(cfg, client_mesh(8, max_devices=2), "grad_avg", "joint")
+    p = _leaves(st.user_params)[0]  # (8, ...) — 4 clients per device
+    for c in range(1, 8):
+        np.testing.assert_array_equal(p[0], p[c])
+
+
+def test_cohort_weighted_param_sync_exact():
+    """Weighted FedAvg over cohorts == hand-computed weighted mean, with the
+    dropped client (weight 0) inside a cohort still adopting the aggregate."""
+    cfg = small_cfg(fed__num_clients=8)
+    mesh = client_mesh(8, max_devices=4)
+    # diverge clients first with local training
+    st, _, _, _ = _run_steps(cfg, mesh, "local", "joint")
+    pre = _leaves(st.user_params)
+    w = np.array([0.0, 1.0, 3.0, 1.0, 2.0, 1.0, 1.0, 1.0], np.float32)
+    sync = build_param_sync(cfg, mesh)
+    st2 = sync(st, jnp.asarray(w))
+    for leaf_pre, leaf_post in zip(pre, _leaves(st2.user_params)):
+        expect = np.tensordot(w, leaf_pre, axes=(0, 0)) / w.sum()
+        for c in range(8):  # every client (incl. weight-0) adopts the mean
+            np.testing.assert_allclose(leaf_post[c], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_cohort_decoupled_news_update_matches():
+    """Decoupled mode on cohorts: per-client news-grad accumulators are
+    packing-independent (no collectives touch them — a pure vmap
+    correctness check, so the comparison is tight), and the epoch-end
+    head update runs and matches loosely (its Adam step shares the
+    near-zero-grad conditioning caveat of the grad_avg test above)."""
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    outs = []
+    for max_dev in (8, 4):
+        mesh = client_mesh(8, max_devices=max_dev)
+        st, losses, model, token_states = _run_steps(
+            cfg, mesh, "local", "decoupled", n_steps=2
+        )
+        accum = np.asarray(st.news_grad_accum)
+        upd = build_news_update_step(model, cfg, mesh, get_strategy("grad_avg"))
+        st, tables = upd(st, token_states)
+        outs.append((losses, accum, np.asarray(tables)))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-5)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(outs[0][2], outs[1][2], rtol=1e-2, atol=1e-3)
+
+
+def test_cohort_seq_parallel_runs():
+    """Cohorts compose with sequence parallelism: 4 clients x seq 2 on 4
+    devices (2 client slots -> cohort of 2) matches the 8-device k=1 run."""
+    from fedrec_tpu.parallel import fed_mesh, shard_fed_batch
+    from fedrec_tpu.parallel.mesh import CLIENT_AXIS  # noqa: F401
+
+    cfg = small_cfg(
+        fed__num_clients=4, fed__seq_shards=2, optim__user_lr=3e-3,
+        optim__news_lr=3e-3, data__max_his_len=10,
+    )
+    results = []
+    for max_dev in (8, 4):
+        import jax as _jax
+
+        devices = _jax.devices()[:max_dev]
+        from jax.sharding import Mesh
+
+        n_seq = cfg.fed.seq_shards
+        cli_slots = len(devices) // n_seq
+        size = cfg.fed.num_clients if cfg.fed.num_clients <= cli_slots else cli_slots
+        mesh = Mesh(
+            np.array(devices[: size * n_seq]).reshape(size, n_seq),
+            (cfg.fed.mesh_axis, cfg.fed.seq_axis),
+        )
+        data, batcher, token_states, model, stacked, _ = make_setup(cfg, seed=0)
+        step = build_fed_train_step(
+            model, cfg, get_strategy("grad_avg"), mesh, mode="joint"
+        )
+        losses = []
+        for i, b in enumerate(batcher.epoch_batches_sharded(cfg.fed.num_clients, 0)):
+            batch = shard_fed_batch(mesh, _batch_dict(b), cfg)
+            stacked, metrics = step(stacked, batch, token_states)
+            losses.append(float(np.mean(np.asarray(metrics["mean_loss"]))))
+            if i >= 1:
+                break
+        results.append((losses, _leaves(stacked.user_params)))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-5)
+    # param comparison intentionally omitted: see the conditioning note on
+    # test_cohort_grad_avg_matches_one_client_per_device
+
+
+def test_cohort_dpsgd_smoke():
+    """Per-example DP-SGD composes with cohorts (per-client noise keys live
+    in the vmapped state block)."""
+    cfg = small_cfg(fed__num_clients=8)
+    cfg.privacy.enabled = True
+    cfg.privacy.mechanism = "dpsgd"
+    cfg.privacy.clip_norm = 1.0
+    cfg.privacy.sigma = 0.5
+    st, losses, _, _ = _run_steps(
+        cfg, client_mesh(8, max_devices=4), "grad_avg", "joint", n_steps=2
+    )
+    assert all(np.isfinite(losses))
+
+
+def test_trainer_end_to_end_with_cohorts(tmp_path):
+    """The full Trainer drive (rounds, participation, eval, snapshot) with
+    16 clients on the 8-device rig — the oversubscribed deployment a
+    32-client federation on a smaller slice actually runs."""
+    from fedrec_tpu.data import make_synthetic_mind
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = small_cfg(fed__num_clients=16, optim__user_lr=3e-3)
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = 2
+    cfg.train.snapshot_dir = str(tmp_path)
+    rng = np.random.default_rng(0)
+    data = make_synthetic_mind(
+        num_news=64, num_train=256, num_valid=32,
+        title_len=cfg.data.max_title_len,
+        his_len_range=(2, cfg.data.max_his_len),
+        seed=0, popular_frac=0.2,
+    )
+    token_states = rng.standard_normal(
+        (64, cfg.data.max_title_len, cfg.model.bert_hidden)
+    ).astype(np.float32)
+    trainer = Trainer(cfg, data, token_states)
+    history = trainer.run()
+    assert len(history) == 2
+    assert all(np.isfinite(h.train_loss) for h in history)
+    metrics = trainer.evaluate()
+    assert np.isfinite(metrics["auc"])
