@@ -34,15 +34,22 @@ verify_bench() { # fresh real-chip primary: platform tpu, not a cached replay
 verify_pallas() { # refuses to run off-TPU, so its table implies the chip
   grep -q 'on tpu' /tmp/chipq_pallas.out
 }
-verify_step_profile() { # chip runs land in step_profile.json (CPU: *_cpu.json)
-  # -nt the run's start sentinel: a STALE tpu-stamped artifact from an earlier
-  # window must not bank a run that produced no fresh chip evidence
-  [ benchmarks/step_profile.json -nt "$MARK/.start_step_profile" ] 2>/dev/null \
-    && grep -q '"jax_backend": "tpu"' benchmarks/step_profile.json
+# shared JSON-artifact check: artifact newer than THIS run's start sentinel
+# (a stale tpu-stamped artifact from an earlier window must not bank a run
+# that produced no fresh chip evidence) and stamped with a real chip backend.
+# CPU fallbacks write *_cpu.json siblings, leaving these untouched.
+verify_json_artifact() { # artifact_path item_name
+  [ "$1" -nt "$MARK/.start_$2" ] 2>/dev/null \
+    && grep -q '"jax_backend": "tpu"' "$1"
 }
-verify_acc_bf16() { # the leg itself is dtype evidence; require a chip backend
-  [ benchmarks/accuracy_bf16.json -nt "$MARK/.start_acc_bf16" ] 2>/dev/null \
-    && grep -q '"jax_backend": "tpu"' benchmarks/accuracy_bf16.json
+verify_step_profile() {
+  verify_json_artifact benchmarks/step_profile.json step_profile
+}
+verify_acc_bf16() {
+  verify_json_artifact benchmarks/accuracy_bf16.json acc_bf16
+}
+verify_serve() {
+  verify_json_artifact benchmarks/serve_bench.json serve
 }
 
 run_item() { # name timeout cmd...
@@ -62,7 +69,7 @@ run_item() { # name timeout cmd...
 
 while :; do
   remaining=0
-  for n in bench pallas step_profile acc_bf16; do
+  for n in bench pallas step_profile acc_bf16 serve; do
     [ -e "$MARK/$n" ] || remaining=$((remaining + 1))
   done
   if [ "$remaining" -eq 0 ]; then
@@ -75,6 +82,7 @@ while :; do
     run_item pallas 2400 python benchmarks/pallas_bench.py
     run_item step_profile 1800 python benchmarks/step_profile.py
     run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
+    run_item serve 1800 python benchmarks/serve_bench.py
   else
     echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"
   fi

@@ -332,9 +332,19 @@ class Trainer:
                 check_vma=False,
             )
             def adopt(stacked, u, n):
-                local = jax.tree_util.tree_map(lambda x: x[0], stacked)
-                local = local.replace(user_params=u, news_params=n)
-                return jax.tree_util.tree_map(lambda x: x[None], local)
+                # the block may hold a COHORT of k clients (clients > devices,
+                # see train.step.cohort_axes) — every client in the block
+                # adopts the globals; opt states and rngs stay per-client.
+                # (The block-of-1 x[0]/x[None] form this replaces silently
+                # collapsed cohort states to one client.)
+                kb = stacked.step.shape[0]
+                bu = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (kb,) + x.shape), u
+                )
+                bn = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (kb,) + x.shape), n
+                )
+                return stacked.replace(user_params=bu, news_params=bn)
 
             self._adopt_fn = jax.jit(adopt, donate_argnums=(0,))
         self.state = self._adopt_fn(
