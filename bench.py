@@ -225,7 +225,8 @@ def main() -> None:
         )
 
     def measure(bsz: int, iters: int, warmup: int = 3, the_step=None,
-                feats=None, n_clients: int = 1, the_cfg=None):
+                feats=None, n_clients: int = 1, the_cfg=None,
+                batch_maker=None):
         """Overhead-corrected sec/step.
 
         Two honesty rules learned on the axon tunnel (verified against a
@@ -248,7 +249,8 @@ def main() -> None:
             model, the_cfg or cfg, jax.random.PRNGKey(0), num_news, L
         )
         stacked = replicate_state(state0, n_clients, jax.random.PRNGKey(1))
-        batches = [make_batch(s, bsz, n_clients) for s in range(8)]
+        mk = batch_maker or make_batch
+        batches = [mk(s, bsz, n_clients) for s in range(8)]
 
         def chain(k: int) -> float:
             nonlocal stacked
@@ -550,6 +552,41 @@ def main() -> None:
             stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] cohort8 bonus metric failed: {e}\n")
+
+        # epoch-in-jit: lax.scan 32 B=64 steps in ONE dispatch — the per-step
+        # dispatch overhead that makes the b64 row tunnel-bound amortizes
+        # away inside the compiled chain (train.step.build_fed_train_scan;
+        # uncapped step, so the row compares to uncapped_samples_per_sec).
+        # A bonus metric: its failure must not discard the primary numbers.
+        try:
+            from fedrec_tpu.train import build_fed_train_scan, shard_scan_batches
+
+            S = 32
+            scan_step = build_fed_train_scan(
+                model, cfg, get_strategy("grad_avg"), mesh, mode="joint"
+            )
+
+            def make_scan_batch(seed: int, bsz: int, n_clients: int = 1):
+                r = np.random.default_rng(seed)
+                stacked_b = {
+                    "candidates": r.integers(
+                        0, num_news, (S, 1, bsz, C)
+                    ).astype(np.int32),
+                    "history": r.integers(
+                        0, num_news, (S, 1, bsz, H)
+                    ).astype(np.int32),
+                    "labels": np.zeros((S, 1, bsz), np.int32),
+                }
+                return shard_scan_batches(mesh, stacked_b, cfg)
+
+            dt_scan = measure(
+                B, iters=10, the_step=scan_step, batch_maker=make_scan_batch
+            )
+            out["b64_scan_samples_per_sec"] = round(S * B / dt_scan, 2)
+            out["b64_scan_chain_len"] = S
+            stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] scan bonus metric failed: {e}\n")
 
         # decoupled (reference-parity) mode: the text tower leaves the step —
         # news vecs come from a precomputed (N, D) table gather; this is the

@@ -175,6 +175,13 @@ class TrainConfig:
     #             behind the published MIND table, evaluation_functions.py:33-47)
     # "last4"   — deterministic last-4-pool-negatives slice (client.py:159-160)
     eval_protocol: str = "full"
+    # epoch-in-jit: dispatch the train step in lax.scan chains of this many
+    # batches (1 = per-batch dispatch). Amortizes host->device dispatch —
+    # the dominant cost of small-batch steps on remote-dispatch links
+    # (train.step.build_fed_train_scan); trajectories are identical
+    # (tests/test_scan.py). Chains compile for this one static length; a
+    # short epoch tail falls back to per-batch dispatch.
+    scan_steps: int = 1
     log_every: int = 10
     seed: int = 42
     profile: bool = False              # jax.profiler trace around the hot loop

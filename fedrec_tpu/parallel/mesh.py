@@ -115,18 +115,31 @@ def fed_mesh(cfg: Any, local: bool = True) -> Mesh:
     return Mesh(mesh_devices, (cfg.fed.mesh_axis, cfg.fed.seq_axis))
 
 
+def fed_batch_spec(key: str, cfg: Any, mesh: Mesh) -> P:
+    """The ONE per-key batch layout rule: dim 0 over the clients axis;
+    ``history``'s last dim additionally over the seq axis when sequence
+    parallelism is on. Used by ``shard_fed_batch`` and (under a prepended
+    steps dim) by ``train.step.shard_scan_batches`` — change it here and
+    both input paths follow."""
+    if (
+        cfg.fed.seq_shards > 1
+        and cfg.fed.seq_axis in mesh.axis_names
+        and key == "history"
+    ):
+        return P(cfg.fed.mesh_axis, None, cfg.fed.seq_axis)
+    return P(cfg.fed.mesh_axis)
+
+
 def shard_fed_batch(mesh: Mesh, batch: dict, cfg: Any) -> dict:
-    """Shard a train batch for ``fed_mesh``: every array's dim 0 over the
-    clients axis; additionally ``history``'s last dim over the seq axis when
-    sequence parallelism is on (each chip holds its history slice)."""
-    axis = cfg.fed.mesh_axis
+    """Shard a train batch for ``fed_mesh`` per ``fed_batch_spec``."""
     if cfg.fed.seq_shards <= 1 or cfg.fed.seq_axis not in mesh.axis_names:
-        return shard_batch(mesh, batch, axis)
-    out = {}
-    for k, v in batch.items():
-        spec = P(axis, None, cfg.fed.seq_axis) if k == "history" else P(axis)
-        out[k] = jax.device_put(np.asarray(v), NamedSharding(mesh, spec))
-    return out
+        return shard_batch(mesh, batch, cfg.fed.mesh_axis)
+    return {
+        k: jax.device_put(
+            np.asarray(v), NamedSharding(mesh, fed_batch_spec(k, cfg, mesh))
+        )
+        for k, v in batch.items()
+    }
 
 
 def client_sharding(mesh: Mesh, axis: str = CLIENT_AXIS) -> NamedSharding:

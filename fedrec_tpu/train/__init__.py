@@ -1,6 +1,7 @@
 from fedrec_tpu.train.state import ClientState, init_client_state, stack_states
 from fedrec_tpu.train.step import (
     build_eval_step,
+    build_fed_train_scan,
     build_fed_train_step,
     build_full_eval_step,
     build_full_eval_step_sharded,
@@ -8,6 +9,8 @@ from fedrec_tpu.train.step import (
     build_param_sync,
     encode_all_news,
     encode_all_news_sharded,
+    shard_scan_batches,
+    stack_batches,
 )
 
 __all__ = [
@@ -15,11 +18,14 @@ __all__ = [
     "build_eval_step",
     "build_full_eval_step",
     "build_full_eval_step_sharded",
+    "build_fed_train_scan",
     "build_fed_train_step",
     "build_news_update_step",
     "build_param_sync",
     "encode_all_news",
     "encode_all_news_sharded",
     "init_client_state",
+    "shard_scan_batches",
+    "stack_batches",
     "stack_states",
 ]

@@ -47,9 +47,12 @@ from fedrec_tpu.train.step import (
     build_full_eval_step,
     build_full_eval_step_sharded,
     build_news_update_step,
+    build_fed_train_scan,
     build_param_sync,
     encode_all_news,
     encode_all_news_sharded,
+    shard_scan_batches,
+    stack_batches,
 )
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
@@ -162,6 +165,15 @@ class Trainer:
         # jitted programs
         self.train_step = build_fed_train_step(
             self.model, cfg, self.strategy, self.mesh, mode=self.mode
+        )
+        # epoch-in-jit chains (train.scan_steps > 1): one dispatch per
+        # scan_steps batches; the tail of an epoch uses train_step
+        self.train_scan = (
+            build_fed_train_scan(
+                self.model, cfg, self.strategy, self.mesh, mode=self.mode
+            )
+            if cfg.train.scan_steps > 1
+            else None
         )
         self.news_update = build_news_update_step(
             self.model, cfg, self.mesh, self.strategy
@@ -448,25 +460,46 @@ class Trainer:
 
         losses = []
         overflows = []  # device arrays; read once at round end (no per-step sync)
+        scan_s = cfg.train.scan_steps if self.train_scan is not None else 1
+
+        def dispatch(group: list, table) -> None:
+            if len(group) == scan_s and scan_s > 1:
+                stacked = shard_scan_batches(
+                    self.mesh, stack_batches(group), cfg
+                )
+                self.state, metrics = self.train_scan(self.state, stacked, table)
+            else:  # per-batch path; also the short epoch tail under scan
+                for g in group:
+                    self.state, metrics = self.train_step(
+                        self.state, shard_fed_batch(self.mesh, g, cfg), table
+                    )
+                    losses.append(metrics["mean_loss"])
+                    if "unique_overflow" in metrics:
+                        overflows.append(metrics["unique_overflow"])
+                return
+            losses.append(metrics["mean_loss"])  # (scan_s, clients)
+            if "unique_overflow" in metrics:
+                overflows.append(metrics["unique_overflow"])
+
         for local_epoch in range(cfg.fed.local_epochs):
             epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
             table = self._feature_table()
+            group: list = []
             for batch in self.batcher.epoch_batches_sharded(
                 cfg.fed.num_clients, epoch_idx
             ):
-                sharded = shard_fed_batch(
-                    self.mesh,
+                group.append(
                     {
                         "candidates": batch.candidates,
                         "history": batch.history,
                         "labels": batch.labels,
-                    },
-                    cfg,
+                    }
                 )
-                self.state, metrics = self.train_step(self.state, sharded, table)
-                losses.append(metrics["mean_loss"])
-                if "unique_overflow" in metrics:
-                    overflows.append(metrics["unique_overflow"])
+                if len(group) == scan_s:
+                    dispatch(group, table)
+                    group = []
+            if group:
+                dispatch(group, table)
             if self.mode == "decoupled":
                 self.state, tables = self.news_update(self.state, self.token_states)
                 self._table = self._replicate_table(
@@ -499,7 +532,12 @@ class Trainer:
                 self._refresh_table()
 
         if overflows:
-            total = int(np.sum([np.max(np.asarray(o)) for o in overflows]))
+            # per entry: max over clients (replicated psum total per step),
+            # then sum over the entry's steps — a scan chain contributes a
+            # (scan_steps, clients) array and must count EACH overflowed step
+            total = int(
+                np.sum([np.asarray(o).max(axis=-1).sum() for o in overflows])
+            )
             if total > 0:
                 raise RuntimeError(
                     f"data.unique_news_cap={cfg.data.unique_news_cap} "
@@ -508,7 +546,12 @@ class Trainer:
                     "invalid. Raise the cap (or set it to 0 for the exact "
                     "worst-case bound)."
                 )
-        train_loss = float(np.mean([np.mean(np.asarray(l)) for l in losses]))
+        # flat mean over every (step, client) cell: scan chains contribute one
+        # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
+        # so a mean-of-entry-means would overweight the epoch tail
+        train_loss = float(
+            np.mean(np.concatenate([np.asarray(l).reshape(-1) for l in losses]))
+        )
         result = RoundResult(round_idx, train_loss)
         if self.valid_ix is not None and (round_idx + 1) % self.cfg.train.eval_every == 0:
             protocol = self.cfg.train.eval_protocol  # validated in __init__
