@@ -473,6 +473,53 @@ def main() -> None:
         # dispatch-bound and swings ~4x between tunnel windows — a high
         # B=64 reading must not masquerade as "best over sweep")
         best_mfu, best_mfu_b = 0.0, None
+
+        def promote_best_sweep_row() -> None:
+            """Headline = the best sweep row so far (module docstring: B=64
+            is dispatch-bound over the tunnel and swings ~4x between
+            windows; large-B rows are compute-bound and stable). Idempotent
+            and called after EVERY sweep point, so a watchdog kill mid-sweep
+            still banks a promoted artifact — the B=64 capped row is
+            captured into b64_* exactly once, on first promotion."""
+            if not sweep:
+                return
+            best_b = max(sweep, key=lambda k: sweep[k])
+            best_rate = sweep[best_b]
+            if out.get("headline_source") == "flagship_b64":
+                if best_rate <= out["value"]:
+                    return
+                out["b64_samples_per_sec"] = out["value"]
+                out["b64_sec_per_step"] = out["sec_per_step"]
+                out["b64_unique_news_cap"] = out["unique_news_cap"]
+                out["b64_flops_per_step"] = out.get("flops_per_step")
+                if "mfu_estimate" in out:
+                    out["b64_mfu_estimate"] = out["mfu_estimate"]
+            bb = int(best_b)
+            dt_best = bb / best_rate
+            out["value"] = best_rate
+            out["batch_size"] = bb
+            out["sec_per_step"] = round(dt_best, 6)
+            out["unique_news_cap"] = 0  # sweep rows run the uncapped step
+            out["headline_source"] = "b_sweep_uncapped"
+            out.update(baseline_ratios(best_rate))
+            if peak is not None:
+                out["flops_per_step"] = _flops_per_train_step(cfg, bb, num_news)
+                out["mfu_estimate"] = round(
+                    out["flops_per_step"] / dt_best / peak, 4
+                )
+            out["headline_note"] = (
+                "headline is the best row of the B sweep (uncapped step; "
+                "headline_source=b_sweep_uncapped): at B=64 the step is "
+                "tunnel-dispatch-bound, not chip-bound. vs_baseline "
+                "divides by the torch-CPU baseline's best measured rate "
+                "over ITS B sweep INCLUDING dedup-granted rows "
+                "(baseline_rate_used — an optimization the reference "
+                "lacks, granted to keep the ratio conservative); "
+                "vs_reference_no_dedup uses the no-dedup "
+                "reference-equivalent rate. b64_* fields keep the "
+                "round-1/2 flagship point."
+            )
+
         for bsz in (128, 256, 512, 1024, 2048, 4096):
             try:
                 dt_b = measure(bsz, iters=20)
@@ -487,51 +534,10 @@ def main() -> None:
                 if peak is not None and best_mfu_b is not None:
                     out["mfu_best_over_sweep"] = round(best_mfu, 4)
                     out["mfu_best_b"] = best_mfu_b
+                promote_best_sweep_row()
                 stamp_and_cache()
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"[bench] B={bsz} sweep point failed: {e}\n")
-
-        # headline = the best sweep row (see module docstring: B=64 is
-        # dispatch-overhead-bound over the tunnel and swings ~4x between
-        # windows; the large-B rows are compute-bound and stable). The B=64
-        # capped row stays under b64_* for round-1/2 continuity.
-        if sweep:
-            best_b = max(sweep, key=lambda k: sweep[k])
-            if sweep[best_b] > out["value"]:
-                out["b64_samples_per_sec"] = out["value"]
-                out["b64_sec_per_step"] = out["sec_per_step"]
-                out["b64_unique_news_cap"] = out["unique_news_cap"]
-                out["b64_flops_per_step"] = out.get("flops_per_step")
-                if "mfu_estimate" in out:
-                    out["b64_mfu_estimate"] = out["mfu_estimate"]
-                bb = int(best_b)
-                dt_best = bb / sweep[best_b]
-                out["value"] = sweep[best_b]
-                out["batch_size"] = bb
-                out["sec_per_step"] = round(dt_best, 6)
-                out["unique_news_cap"] = 0  # sweep rows run the uncapped step
-                out["headline_source"] = "b_sweep_uncapped"
-                out.update(baseline_ratios(sweep[best_b]))
-                if peak is not None:
-                    out["flops_per_step"] = _flops_per_train_step(
-                        cfg, bb, num_news
-                    )
-                    out["mfu_estimate"] = round(
-                        out["flops_per_step"] / dt_best / peak, 4
-                    )
-                out["headline_note"] = (
-                    "headline is the best row of the B sweep (uncapped step; "
-                    "headline_source=b_sweep_uncapped): at B=64 the step is "
-                    "tunnel-dispatch-bound, not chip-bound. vs_baseline "
-                    "divides by the torch-CPU baseline's best measured rate "
-                    "over ITS B sweep INCLUDING dedup-granted rows "
-                    "(baseline_rate_used — an optimization the reference "
-                    "lacks, granted to keep the ratio conservative); "
-                    "vs_reference_no_dedup uses the no-dedup "
-                    "reference-equivalent rate. b64_* fields keep the "
-                    "round-1/2 flagship point."
-                )
-                stamp_and_cache()
 
         # TRUE 8-client federation on the one chip via a k=8 cohort (vmap
         # over clients, grad-avg collective inside): measures the actual
