@@ -128,32 +128,62 @@ def test_scan_seq_parallel():
     )
 
 
+def _trainer_fixture(cfg, num_train):
+    """data + token_states via the shared make_setup fixture (constants live
+    in ONE place, tests/test_train.py)."""
+    data, _, token_states, _, _, _ = make_setup(cfg, num_train=num_train, seed=0)
+    return data, np.asarray(token_states)
+
+
 def test_trainer_scan_steps_matches_per_batch(tmp_path):
     """Trainer with train.scan_steps=4 produces the same round losses as
     per-batch dispatch (incl. a non-multiple epoch tail on the per-step
     fallback)."""
-    from fedrec_tpu.data import make_synthetic_mind
     from fedrec_tpu.train.trainer import Trainer
 
     def run(scan_steps, snap):
-        cfg = small_cfg(fed__num_clients=8, optim__user_lr=3e-3)
+        cfg = small_cfg(optim__user_lr=3e-3)
         cfg.fed.strategy = "param_avg"
         cfg.fed.rounds = 2
         cfg.train.scan_steps = scan_steps
         cfg.train.snapshot_dir = str(snap)
         cfg.train.eval_every = 1000
-        rng = np.random.default_rng(0)
-        data = make_synthetic_mind(
-            num_news=64, num_train=6 * 64 + 32,  # 6.5 groups -> real tail
-            num_valid=32, title_len=cfg.data.max_title_len,
-            his_len_range=(2, cfg.data.max_his_len), seed=0, popular_frac=0.2,
+        data, token_states = _trainer_fixture(
+            cfg, num_train=6 * 64 + 32  # 6.5 groups -> real tail
         )
-        token_states = rng.standard_normal(
-            (64, cfg.data.max_title_len, cfg.model.bert_hidden)
-        ).astype(np.float32)
         t = Trainer(cfg, data, token_states)
         return [h.train_loss for h in t.run()]
 
     l1 = run(1, tmp_path / "a")
     l4 = run(4, tmp_path / "b")
     np.testing.assert_allclose(l1, l4, rtol=1e-6)
+
+
+def test_scan_overflow_count_matches_per_batch(tmp_path):
+    """A tripped unique_news_cap raises with a PER-STEP count under both
+    dispatch modes (the scan chain's (scan_steps, clients) overflow entry
+    must count each overflowed step, not collapse to 1)."""
+    import re
+
+    from fedrec_tpu.train.trainer import Trainer
+
+    def overflow_count(scan_steps, snap):
+        cfg = small_cfg()
+        cfg.model.text_encoder_mode = "head"  # joint mode — the capped path
+        cfg.fed.strategy = "param_avg"
+        cfg.fed.rounds = 1
+        cfg.train.scan_steps = scan_steps
+        cfg.train.snapshot_dir = str(snap)
+        cfg.train.eval_every = 1000
+        cfg.data.unique_news_cap = 2  # every batch draws far more ids
+        data, token_states = _trainer_fixture(cfg, num_train=4 * 64)
+        t = Trainer(cfg, data, token_states)
+        with pytest.raises(RuntimeError, match="overflowed") as exc:
+            t.run()
+        m = re.search(r"overflowed on (\d+) step", str(exc.value))
+        assert m, str(exc.value)
+        return int(m.group(1))
+
+    n1 = overflow_count(1, tmp_path / "a")
+    n2 = overflow_count(2, tmp_path / "b")
+    assert n1 == n2 and n1 >= 2, (n1, n2)
