@@ -1,0 +1,115 @@
+"""Elastic repacking: resume a snapshot on a DIFFERENT device count.
+
+Snapshots store per-client (num_clients, ...) arrays with no record of the
+client->chip packing, and cohort collectives are packing-independent
+(tests/test_cohorts.py) — so a run snapshotted on 8 devices must resume on
+4 (cohort k=2) and keep training as a continuation. This is the
+"lost half the slice, keep going" deployment story; the reference's
+one-rank-per-client torchrun world cannot shrink without re-sharding its
+DistributedSampler universe (reference ``main.py:166``).
+
+Each phase runs in its own subprocess so the fake device count can differ
+(XLA flags are fixed at interpreter start).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.hostenv import cpu_host_env
+
+REPO = str(Path(__file__).resolve().parents[1])
+
+pytestmark = pytest.mark.slow
+
+PHASE = textwrap.dedent(
+    """
+    import json, sys
+    import numpy as np
+    repo, tests = sys.argv[4], sys.argv[5]
+    sys.path.insert(0, repo)
+    sys.path.insert(0, tests)
+    from test_train import small_cfg, make_setup
+    from fedrec_tpu.train.trainer import Trainer
+
+    snap, rounds, start_fresh = sys.argv[1], int(sys.argv[2]), sys.argv[3] == "1"
+    cfg = small_cfg(optim__user_lr=3e-3)
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = rounds
+    cfg.train.snapshot_dir = snap
+    cfg.train.resume = not start_fresh
+    cfg.train.eval_every = 1000
+    data, _, token_states, _, _, _ = make_setup(cfg, num_train=512, seed=0)
+    t = Trainer(cfg, data, np.asarray(token_states))
+    import jax
+    hist = t.run()
+    print("PHASE_RESULT", json.dumps({
+        "devices": len(jax.local_devices()),
+        "start_round": t.start_round,
+        "losses": [h.train_loss for h in hist],
+    }))
+    """
+)
+
+
+def _run_phase(tmp_path, snap, rounds, n_devices, fresh):
+    script = tmp_path / f"phase_{n_devices}_{rounds}_{fresh}.py"
+    script.write_text(PHASE)
+    env = cpu_host_env(n_devices)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(script), str(snap), str(rounds),
+         "1" if fresh else "0", REPO, str(Path(REPO) / "tests")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    line = next(
+        ln for ln in proc.stdout.splitlines() if ln.startswith("PHASE_RESULT")
+    )
+    return json.loads(line.split(" ", 1)[1])
+
+
+def test_resume_on_fewer_devices(tmp_path):
+    snap = tmp_path / "snap"
+    # phase 1: 2 rounds on 8 devices (k=1)
+    p1 = _run_phase(tmp_path, snap, 2, 8, fresh=True)
+    assert p1["devices"] == 8 and p1["start_round"] == 0
+    # phase 2: resume the SAME snapshot on 4 devices (cohort k=2), 2 more
+    p2 = _run_phase(tmp_path, snap, 4, 4, fresh=False)
+    assert p2["devices"] == 4
+    assert p2["start_round"] == 2, "must resume, not restart"
+    # continuation: training keeps improving from phase 1's endpoint
+    assert p2["losses"][0] < p1["losses"][0]
+    assert p2["losses"][-1] < p1["losses"][-1]
+
+    # control: 4 rounds uninterrupted on 8 devices — the repacked resume
+    # tracks it closely (packing changes only f32 reduction order)
+    ctrl = _run_phase(tmp_path, tmp_path / "snap_ctrl", 4, 8, fresh=True)
+    np.testing.assert_allclose(
+        p1["losses"] + p2["losses"], ctrl["losses"], rtol=5e-3
+    )
+
+
+def test_resume_on_more_devices(tmp_path):
+    """The grow direction: snapshot at 4 devices (k=2), resume at 8 (k=1),
+    with the same uninterrupted-control trajectory check as the shrink
+    test."""
+    snap = tmp_path / "snap"
+    p1 = _run_phase(tmp_path, snap, 1, 4, fresh=True)
+    assert p1["devices"] == 4
+    p2 = _run_phase(tmp_path, snap, 2, 8, fresh=False)
+    assert p2["devices"] == 8 and p2["start_round"] == 1
+    assert p2["losses"][-1] < p1["losses"][-1]
+
+    ctrl = _run_phase(tmp_path, tmp_path / "snap_ctrl", 2, 4, fresh=True)
+    np.testing.assert_allclose(
+        p1["losses"] + p2["losses"], ctrl["losses"], rtol=5e-3
+    )
