@@ -336,6 +336,8 @@ def leg_fed(rounds: int) -> None:
         # 8-device rig -> 4 per device; packing-independent semantics
         # pinned by tests/test_cohorts.py)
         "param_avg_32_cohort": ("param_avg", 32, None, "head"),
+        # second model family: recurrent (LSTUR-style) user tower
+        "gru_tower_8": ("param_avg", 8, None, "head+gru"),
         # two epsilons -> a privacy-utility tradeoff, not one crushed point
         "param_avg_8_dp50": ("param_avg", 8, 50.0, "head"),
         "param_avg_8_dp10": ("param_avg", 8, 10.0, "head"),
@@ -346,6 +348,9 @@ def leg_fed(rounds: int) -> None:
             cfg.fed.server_opt = "sgd"
             cfg.fed.server_lr = 1.0
             cfg.fed.server_momentum = 0.9
+        if mode.endswith("+gru"):
+            mode = mode.split("+")[0]
+            cfg.model.user_tower = "gru"
         cfg.model.text_encoder_mode = mode
         cfg.model.news_dim = 64
         cfg.model.num_heads = 8

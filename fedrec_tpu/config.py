@@ -61,6 +61,15 @@ class ModelConfig:
     head_dim: int = 20                 # d_k = d_v
     query_dim: int = 200               # additive-attention query hidden
     dropout_rate: float = 0.2
+    # user-tower family:
+    #   "mha" — self-attention encoder (reference parity, encoder.py:36-56)
+    #   "gru" — recurrent encoder (LSTUR-family, An et al. 2019): GRU over
+    #           the click sequence + additive-attention pooling of the
+    #           hidden states. Order-aware where MHA+pool is permutation-
+    #           equivariant; lax.scan-based, so jit-friendly on TPU. Not
+    #           combinable with fed.seq_shards>1 (sequence parallelism is
+    #           attention-specific).
+    user_tower: str = "mha"
     bert_hidden: int = 768             # DistilBERT hidden size
     # "table"    — gather a precomputed news-embedding table (fast path)
     # "head"     — frozen-trunk token states + trainable additive-attn/linear head

@@ -48,6 +48,68 @@ class TextHead(nn.Module):
         return nn.Dense(self.news_dim, dtype=self.dtype, name="fc")(pooled)
 
 
+class GRUUserEncoder(nn.Module):
+    """Recurrent user tower (LSTUR family, An et al. 2019 "Neural News
+    Recommendation with Long- and Short-term User Representations"):
+    dropout -> GRU over the click sequence -> additive attention over the
+    hidden states -> (..., news_dim) user vector.
+
+    A second model family beyond the reference's single MHA architecture
+    (reference ``encoder.py:36-56``): order-AWARE where attention+pool is
+    permutation-equivariant over history. TPU-native by construction — the
+    GRU is a ``lax.scan`` (via ``nn.RNN``), static shapes, no Python loop.
+    Interchangeable with ``UserEncoder`` behind ``model.user_tower``; the
+    parameter tree differs, so snapshots are per-family (the config rides
+    with the snapshot, ``train/checkpoint.py``).
+
+    Padding semantics: with ``mask=None`` (the default every call site
+    uses) tail-pad rows run through the recurrence exactly like the MHA
+    tower attends over them — the reference's no-mask behavior
+    (``encoder.py:28``, ``dataset.py:83-85``), kept so the two towers see
+    IDENTICAL inputs and accuracy rows compare towers, nothing else. Pass
+    ``mask`` (1 = real click, tail-padded) to get masked semantics: the
+    recurrence stops at each row's true length (``nn.RNN seq_lengths``)
+    and the pool ignores pad positions.
+    """
+
+    news_dim: int = 400
+    query_dim: int = 200
+    dropout_rate: float = 0.2
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        clicked_vecs: jnp.ndarray,
+        mask: jnp.ndarray | None = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(clicked_vecs)
+        # nn.RNN scans a GRUCell over the time axis; flatten any extra
+        # leading dims to one batch dim first (eval paths pass (B, H, D),
+        # per-example DP paths (1, H, D))
+        lead = x.shape[:-2]
+        flat = x.reshape((-1,) + x.shape[-2:])
+        seq_lengths = None
+        if mask is not None:
+            seq_lengths = mask.reshape(-1, mask.shape[-1]).sum(-1).astype(
+                jnp.int32
+            )
+        outs = nn.RNN(
+            nn.GRUCell(self.news_dim, dtype=self.dtype), name="gru"
+        )(flat, seq_lengths=seq_lengths)
+        outs = outs.reshape(lead + outs.shape[-2:])
+        return AdditiveAttention(
+            hidden=self.query_dim,
+            stable_softmax=self.stable_softmax,
+            dtype=self.dtype,
+            use_pallas=self.use_pallas,
+            name="pool",
+        )(outs, mask)
+
+
 class UserEncoder(nn.Module):
     """(..., H, news_dim) clicked-news vectors -> (..., news_dim) user vector."""
 

@@ -19,7 +19,7 @@ import optax
 from flax import linen as nn
 
 from fedrec_tpu.config import ModelConfig
-from fedrec_tpu.models.encoders import TextHead, UserEncoder
+from fedrec_tpu.models.encoders import GRUUserEncoder, TextHead, UserEncoder
 
 
 def score_candidates(cand_vecs: jnp.ndarray, user_vec: jnp.ndarray) -> jnp.ndarray:
@@ -79,20 +79,43 @@ class NewsRecommender(nn.Module):
             dtype=dtype,
             use_pallas=self.cfg.use_pallas,
         )
-        self.user_encoder = UserEncoder(
-            news_dim=self.cfg.news_dim,
-            num_heads=self.cfg.num_heads,
-            head_dim=self.cfg.head_dim,
-            query_dim=self.cfg.query_dim,
-            dropout_rate=self.cfg.dropout_rate,
-            stable_softmax=self.cfg.stable_softmax,
-            dtype=dtype,
-            use_pallas=self.cfg.use_pallas,
-            seq_axis=self.seq_axis,
-            seq_impl=self.seq_impl,
-            attn_impl=self.cfg.attn_impl,
-            chunk_threshold=self.cfg.attn_chunk_threshold,
-        )
+        tower = getattr(self.cfg, "user_tower", "mha")
+        if tower == "gru":
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "model.user_tower='gru' cannot run under fed.seq_shards>1 "
+                    "(sequence parallelism is attention-specific); use the "
+                    "'mha' tower for seq-sharded histories"
+                )
+            # attribute name (hence param-tree path "user_encoder") is shared
+            # across families; the leaves differ, so snapshots are per-family
+            self.user_encoder = GRUUserEncoder(
+                news_dim=self.cfg.news_dim,
+                query_dim=self.cfg.query_dim,
+                dropout_rate=self.cfg.dropout_rate,
+                stable_softmax=self.cfg.stable_softmax,
+                dtype=dtype,
+                use_pallas=self.cfg.use_pallas,
+            )
+        elif tower == "mha":
+            self.user_encoder = UserEncoder(
+                news_dim=self.cfg.news_dim,
+                num_heads=self.cfg.num_heads,
+                head_dim=self.cfg.head_dim,
+                query_dim=self.cfg.query_dim,
+                dropout_rate=self.cfg.dropout_rate,
+                stable_softmax=self.cfg.stable_softmax,
+                dtype=dtype,
+                use_pallas=self.cfg.use_pallas,
+                seq_axis=self.seq_axis,
+                seq_impl=self.seq_impl,
+                attn_impl=self.cfg.attn_impl,
+                chunk_threshold=self.cfg.attn_chunk_threshold,
+            )
+        else:
+            raise ValueError(
+                f"unknown model.user_tower {tower!r}; have 'mha', 'gru'"
+            )
 
     def encode_news(
         self, token_states: jnp.ndarray, mask: jnp.ndarray | None = None

@@ -429,3 +429,19 @@ def test_server_opt_resume_bit_identical(tmp_path):
     np.testing.assert_allclose(
         _flat_params(t_a), _flat_params(t_b2), rtol=1e-6, atol=1e-7
     )
+
+
+def test_gru_tower_federated_training_loss_decreases():
+    """The second model family (model.user_tower='gru') drives the SAME
+    federated step/mesh machinery end-to-end."""
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    cfg.model.user_tower = "gru"
+    _, batcher, token_states, model, stacked, mesh = make_setup(cfg)
+    step = build_fed_train_step(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
+    losses = []
+    for epoch in range(3):
+        for b in batcher.epoch_batches_sharded(cfg.fed.num_clients, epoch):
+            batch = shard_batch(mesh, _batch_dict(b))
+            stacked, metrics = step(stacked, batch, token_states)
+            losses.append(float(np.mean(np.asarray(metrics["mean_loss"]))))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses[0]} -> {losses[-1]}"
