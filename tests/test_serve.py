@@ -134,3 +134,36 @@ def test_recommend_valid_mask(setup):
     )
     ids = np.asarray(ids)
     assert np.all((ids < 50) & (ids > 0))
+
+
+def test_recommend_with_gru_tower():
+    """Serving is user-tower-family-agnostic: the GRU tower's params drive
+    the same jitted top-k path."""
+    cfg = ExperimentConfig()
+    cfg.model.bert_hidden = 32
+    cfg.model.news_dim = 32
+    cfg.model.query_dim = 16
+    cfg.model.user_tower = "gru"
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(5)
+    n, d, b, h = 100, cfg.model.news_dim, 4, 10
+    news_vecs = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    history = jnp.asarray(rng.integers(1, n, (b, h)).astype(np.int32))
+    his_vecs = news_vecs[history]
+    params = model.init(
+        jax.random.PRNGKey(0), his_vecs, his_vecs,
+        method=NewsRecommender.__call__,
+    )["params"]["user_encoder"]
+    fn = build_recommend_fn(model, top_k=5)
+    ids, scores = jax.tree_util.tree_map(np.asarray, fn(params, news_vecs, history))
+    assert ids.shape == (b, 5) and np.isfinite(scores).all()
+    # scores must really come from the GRU tower: brute-force cross-check
+    user = model.apply(
+        {"params": {"user_encoder": params}}, his_vecs,
+        method=NewsRecommender.encode_user,
+    )
+    full = np.asarray(jnp.einsum("nd,bd->bn", news_vecs, user))
+    for i in range(b):
+        np.testing.assert_array_equal(
+            np.sort(ids[i]), np.sort(np.argsort(-full[i])[:5])
+        )
