@@ -187,3 +187,30 @@ def test_scan_overflow_count_matches_per_batch(tmp_path):
     n1 = overflow_count(1, tmp_path / "a")
     n2 = overflow_count(2, tmp_path / "b")
     assert n1 == n2 and n1 >= 2, (n1, n2)
+
+
+def test_scan_cohorts_gru_compose():
+    """Every axis of the round-3 feature matrix in one program: the GRU
+    user tower, k=2 cohorts, and an epoch-in-jit scan chain — matching the
+    per-step loop trajectory exactly."""
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    cfg.model.user_tower = "gru"
+    mesh = client_mesh(8, max_devices=4)
+    data, batcher, token_states, model, stacked0, _ = make_setup(cfg, seed=0)
+    batches = _collect_batches(batcher, 8, 3)
+
+    step = build_fed_train_step(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
+    st = stacked0
+    loop_losses = []
+    for b in batches:
+        st, m = step(st, shard_batch(mesh, b), token_states)
+        loop_losses.append(np.asarray(m["mean_loss"]))
+
+    _, _, _, _, stacked0b, _ = make_setup(cfg, seed=0)
+    scan = build_fed_train_scan(model, cfg, get_strategy("grad_avg"), mesh, mode="joint")
+    _, ms = scan(
+        stacked0b, shard_scan_batches(mesh, stack_batches(batches), cfg), token_states
+    )
+    np.testing.assert_allclose(
+        np.stack(loop_losses), np.asarray(ms["mean_loss"]), rtol=1e-6, atol=1e-7
+    )
