@@ -135,6 +135,124 @@ def _flops_per_train_step(cfg, batch_size: int, num_news: int) -> float:
     return 3.0 * fwd  # fwd + ~2x fwd for backward
 
 
+def _baseline_ratios(
+    baseline_path: Path, rate: float, our_sweep: dict | None = None
+) -> dict:
+    """Both cross-platform ratios, same convention on every path.
+
+    vs_baseline: conservative — divides by the torch baseline's best
+    measured rate over ITS B sweep INCLUDING the dedup-granted rows (an
+    optimization the reference lacks; reported via baseline_rate_used).
+    vs_reference_no_dedup: the reference-equivalent no-dedup rate (the
+    reference re-encodes per sample, model.py:41-61).
+
+    Clamp rule (ADVICE r3): when our sweep extends past the largest B the
+    baseline measured, the ratio numerator is our best rate among rows the
+    baseline also measured — never a row whose baseline counterpart is an
+    unmeasured assumption. The clamp becomes a no-op once
+    ``benchmarks/torch_baseline.py --extend`` fills the baseline sweep to
+    the same max B. Module-level (not nested in main) so the policy is
+    unit-testable: tests/test_bench_policy.py.
+    """
+    if not baseline_path.exists():
+        return {}
+    base = json.loads(baseline_path.read_text())
+    base_sweep = base.get("b_sweep_samples_per_sec") or {}
+    base_rate = max([base["samples_per_sec"], *base_sweep.values()])
+    ref_rates = [
+        v for k, v in base_sweep.items() if not k.endswith("_dedup")
+    ] or [base["samples_per_sec"]]
+    fields: dict = {}
+    cmp_rate = rate
+    base_max_b = max((int(k.split("_")[0]) for k in base_sweep), default=None)
+    if our_sweep and base_max_b is not None:
+        eligible = [v for k, v in our_sweep.items() if int(k) <= base_max_b]
+        if eligible and max(eligible) < rate:
+            cmp_rate = max(eligible)
+            fields["ratio_rate_used"] = cmp_rate
+            fields["ratio_clamped_to_b"] = base_max_b
+        elif not eligible:
+            # no measured row in the baseline's range at all (every small-B
+            # point failed this window) — the ratio then compares beyond
+            # the baseline's measured range; say so rather than silently
+            # reinstating the unmeasured-baseline assumption
+            fields["ratio_beyond_baseline_range"] = True
+    fields.update(
+        {
+            "vs_baseline": round(cmp_rate / base_rate, 2),
+            "baseline_rate_used": base_rate,
+            "vs_reference_no_dedup": round(cmp_rate / max(ref_rates), 2),
+        }
+    )
+    return fields
+
+
+def _promote_best_sweep_row(out: dict, sweep: dict, flops_of, peak, ratios) -> None:
+    """Headline = the best sweep row, UNCONDITIONALLY once any sweep row
+    exists (module docstring: B=64 is dispatch-bound over the tunnel and
+    swings ~4x between windows; large-B rows are compute-bound and stable —
+    so even a B=64 reading that beats every sweep row is a fast-window
+    artifact, not a better number; ADVICE r3). Idempotent and called after
+    EVERY sweep point, so a watchdog kill mid-sweep still banks a promoted
+    artifact — the B=64 capped row is captured into b64_* exactly once, on
+    first promotion. ``flops_of(b)`` returns analytic step FLOPs at batch
+    ``b``; ``ratios(rate, our_sweep=...)`` returns the baseline-ratio
+    fields. Module-level so the policy is unit-testable.
+    """
+    if not sweep:
+        return
+    best_b = max(sweep, key=lambda k: sweep[k])
+    best_rate = sweep[best_b]
+    if out.get("headline_source") == "flagship_b64":
+        out["b64_samples_per_sec"] = out["value"]
+        out["b64_sec_per_step"] = out["sec_per_step"]
+        out["b64_unique_news_cap"] = out["unique_news_cap"]
+        out["b64_flops_per_step"] = out.get("flops_per_step")
+        if "mfu_estimate" in out:
+            out["b64_mfu_estimate"] = out["mfu_estimate"]
+    bb = int(best_b)
+    dt_best = bb / best_rate
+    out["value"] = best_rate
+    out["batch_size"] = bb
+    out["sec_per_step"] = round(dt_best, 6)
+    out["unique_news_cap"] = 0  # sweep rows run the uncapped step
+    out["headline_source"] = "b_sweep_uncapped"
+    # clamp candidates: the sweep rows plus the B=64 flagship (a measured,
+    # dispatch-bound — hence conservative — point inside the baseline's
+    # range, so a window where every small-B sweep point failed still
+    # clamps to a measured row instead of comparing beyond the baseline)
+    candidates = dict(sweep)
+    if out.get("b64_samples_per_sec") is not None:
+        candidates.setdefault("64", out["b64_samples_per_sec"])
+    # the ratio fields are recomputed whole each promotion: drop any stale
+    # clamp annotations from an earlier promotion where the clamp bit
+    for stale in (
+        "ratio_rate_used", "ratio_clamped_to_b", "ratio_beyond_baseline_range",
+    ):
+        out.pop(stale, None)
+    out.update(ratios(best_rate, our_sweep=candidates))
+    # flops are analytic (no peak needed); mfu needs the chip's peak
+    out["flops_per_step"] = flops_of(bb)
+    if peak is not None:
+        out["mfu_estimate"] = round(out["flops_per_step"] / dt_best / peak, 4)
+    else:
+        out.pop("mfu_estimate", None)
+    out["headline_note"] = (
+        "headline is the best row of the B sweep (uncapped step; "
+        "headline_source=b_sweep_uncapped): at B=64 the step is "
+        "tunnel-dispatch-bound, not chip-bound. vs_baseline divides by "
+        "the torch-CPU baseline's best measured rate over ITS B sweep "
+        "INCLUDING dedup-granted rows (baseline_rate_used — an "
+        "optimization the reference lacks, granted to keep the ratio "
+        "conservative); vs_reference_no_dedup uses the no-dedup "
+        "reference-equivalent rate. When our sweep extends past the "
+        "baseline's largest measured B, both ratios use our best rate "
+        "among Bs the baseline also measured "
+        "(ratio_rate_used/ratio_clamped_to_b appear when the clamp "
+        "bites). b64_* fields keep the round-1/2 flagship point."
+    )
+
+
 def main() -> None:
     inner = os.environ.get(_INNER)
     if inner is None:
@@ -348,29 +466,8 @@ def main() -> None:
 
     baseline_path = Path(__file__).parent / "benchmarks" / "baseline_host.json"
 
-    def baseline_ratios(rate: float) -> dict:
-        """Both cross-platform ratios, same convention on every path.
-
-        vs_baseline: conservative — divides by the torch baseline's best
-        measured rate over ITS B sweep INCLUDING the dedup-granted rows
-        (an optimization the reference lacks; reported via
-        baseline_rate_used). vs_reference_no_dedup: the reference-
-        equivalent no-dedup rate (the reference re-encodes per sample,
-        model.py:41-61).
-        """
-        if not baseline_path.exists():
-            return {}
-        base = json.loads(baseline_path.read_text())
-        base_sweep = base.get("b_sweep_samples_per_sec") or {}
-        base_rate = max([base["samples_per_sec"], *base_sweep.values()])
-        ref_rates = [
-            v for k, v in base_sweep.items() if not k.endswith("_dedup")
-        ] or [base["samples_per_sec"]]
-        return {
-            "vs_baseline": round(rate / base_rate, 2),
-            "baseline_rate_used": base_rate,
-            "vs_reference_no_dedup": round(rate / max(ref_rates), 2),
-        }
+    def baseline_ratios(rate: float, our_sweep: dict | None = None) -> dict:
+        return _baseline_ratios(baseline_path, rate, our_sweep)
 
     out.update(baseline_ratios(samples_per_sec))
 
@@ -417,19 +514,34 @@ def main() -> None:
                 out["flops_per_step"] = flops
                 break
 
+        # Read the incumbent artifact ONCE, before this run's first stamp
+        # can touch the file: both the staging guard and the end-of-sweep
+        # reconcile must see the PRE-RUN artifact, not this run's own
+        # partial writes (a mid-loop overwrite would otherwise permanently
+        # lose incumbent rows this window fails to re-measure).
+        staged_path = cache_path.with_suffix(".inprogress.json")
+        try:
+            incumbent0 = (
+                json.loads(cache_path.read_text()) if cache_path.exists() else None
+            )
+        except Exception:  # noqa: BLE001 — unreadable incumbent
+            incumbent0 = None
+
         def stamp_and_cache():
             # primary evidence; stamped so a later cached read-back carries
             # its real provenance (wall time + code revision measured).
             # Called after EVERY metric lands so a bonus-metric failure (or
             # a tunnel wedge mid-bonus) can never discard what's measured.
             #
-            # Clobber guard: a RETRIED run's first stamps are sparse (the
-            # B=64 primary only). When the incumbent artifact is for the
-            # SAME commit and already holds the B sweep, the sparse rerun
-            # stages into *.inprogress.json instead — it promotes onto the
-            # real path the moment it regains the sweep. A different-commit
-            # incumbent is always overwritten: fresh evidence for the
-            # current tree beats rich evidence for an older one.
+            # Clobber guard (ADVICE r3): while a SAME-COMMIT incumbent holds
+            # sweep rows this run has not (re-)measured, stamps stage into
+            # *.inprogress.json — coverage by row KEYS, not counts, so an
+            # incumbent row set disjoint from this run's is protected too.
+            # The end-of-sweep reconcile merges the missing rows, after
+            # which stamps land on the real path and the staged file is
+            # removed. A different-commit incumbent is always overwritten:
+            # fresh evidence for the current tree beats rich evidence for
+            # an older one.
             from fedrec_tpu.utils.provenance import provenance
 
             stamp = provenance()
@@ -437,17 +549,16 @@ def main() -> None:
             out["measured_commit"] = stamp["commit"]
             out["provenance"] = stamp
             target = cache_path
-            if "b_sweep_samples_per_sec" not in out and cache_path.exists():
-                try:
-                    incumbent = json.loads(cache_path.read_text())
-                    if (
-                        incumbent.get("measured_commit") == stamp["commit"]
-                        and "b_sweep_samples_per_sec" in incumbent
-                    ):
-                        target = cache_path.with_suffix(".inprogress.json")
-                except Exception:  # noqa: BLE001 — unreadable incumbent
-                    pass
+            if (
+                incumbent0 is not None
+                and incumbent0.get("measured_commit") == stamp["commit"]
+                and set(incumbent0.get("b_sweep_samples_per_sec") or {})
+                - set(out.get("b_sweep_samples_per_sec") or {})
+            ):
+                target = staged_path
             target.write_text(json.dumps(out, indent=2))
+            if target == cache_path:
+                staged_path.unlink(missing_ok=True)
 
         stamp_and_cache()  # the B=64 primary is in the bank
 
@@ -475,49 +586,12 @@ def main() -> None:
         best_mfu, best_mfu_b = 0.0, None
 
         def promote_best_sweep_row() -> None:
-            """Headline = the best sweep row so far (module docstring: B=64
-            is dispatch-bound over the tunnel and swings ~4x between
-            windows; large-B rows are compute-bound and stable). Idempotent
-            and called after EVERY sweep point, so a watchdog kill mid-sweep
-            still banks a promoted artifact — the B=64 capped row is
-            captured into b64_* exactly once, on first promotion."""
-            if not sweep:
-                return
-            best_b = max(sweep, key=lambda k: sweep[k])
-            best_rate = sweep[best_b]
-            if out.get("headline_source") == "flagship_b64":
-                if best_rate <= out["value"]:
-                    return
-                out["b64_samples_per_sec"] = out["value"]
-                out["b64_sec_per_step"] = out["sec_per_step"]
-                out["b64_unique_news_cap"] = out["unique_news_cap"]
-                out["b64_flops_per_step"] = out.get("flops_per_step")
-                if "mfu_estimate" in out:
-                    out["b64_mfu_estimate"] = out["mfu_estimate"]
-            bb = int(best_b)
-            dt_best = bb / best_rate
-            out["value"] = best_rate
-            out["batch_size"] = bb
-            out["sec_per_step"] = round(dt_best, 6)
-            out["unique_news_cap"] = 0  # sweep rows run the uncapped step
-            out["headline_source"] = "b_sweep_uncapped"
-            out.update(baseline_ratios(best_rate))
-            if peak is not None:
-                out["flops_per_step"] = _flops_per_train_step(cfg, bb, num_news)
-                out["mfu_estimate"] = round(
-                    out["flops_per_step"] / dt_best / peak, 4
-                )
-            out["headline_note"] = (
-                "headline is the best row of the B sweep (uncapped step; "
-                "headline_source=b_sweep_uncapped): at B=64 the step is "
-                "tunnel-dispatch-bound, not chip-bound. vs_baseline "
-                "divides by the torch-CPU baseline's best measured rate "
-                "over ITS B sweep INCLUDING dedup-granted rows "
-                "(baseline_rate_used — an optimization the reference "
-                "lacks, granted to keep the ratio conservative); "
-                "vs_reference_no_dedup uses the no-dedup "
-                "reference-equivalent rate. b64_* fields keep the "
-                "round-1/2 flagship point."
+            _promote_best_sweep_row(
+                out,
+                sweep,
+                flops_of=lambda b: _flops_per_train_step(cfg, b, num_news),
+                peak=peak,
+                ratios=baseline_ratios,
             )
 
         for bsz in (128, 256, 512, 1024, 2048, 4096):
@@ -538,6 +612,29 @@ def main() -> None:
                 stamp_and_cache()
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"[bench] B={bsz} sweep point failed: {e}\n")
+
+        # Reconcile with the same-commit incumbent once the sweep loop is
+        # done trying: rows THIS run failed to re-measure (a transient
+        # wedge on one point) are merged from the PRE-RUN incumbent copy —
+        # same code, earlier window, annotated — so the final artifact is
+        # a superset and the staging guard in stamp_and_cache can never
+        # strand a finished run in .inprogress.json.
+        try:
+            if (
+                sweep
+                and incumbent0 is not None
+                and incumbent0.get("measured_commit") == out.get("measured_commit")
+            ):
+                inc_sweep = incumbent0.get("b_sweep_samples_per_sec") or {}
+                carried = {k: v for k, v in inc_sweep.items() if k not in sweep}
+                if carried:
+                    sweep.update(carried)
+                    out["b_sweep_samples_per_sec"] = sweep
+                    out["sweep_rows_from_incumbent"] = sorted(carried)
+                    promote_best_sweep_row()
+                stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] sweep reconcile failed: {e}\n")
 
         # TRUE 8-client federation on the one chip via a k=8 cohort (vmap
         # over clients, grad-avg collective inside): measures the actual
@@ -588,8 +685,13 @@ def main() -> None:
             dt_scan = measure(
                 B, iters=10, the_step=scan_step, batch_maker=make_scan_batch
             )
-            out["b64_scan_samples_per_sec"] = round(S * B / dt_scan, 2)
-            out["b64_scan_chain_len"] = S
+            # first-class dispatch-insensitive companion to the headline
+            # (VERDICT r3 #8): one compiled chain of S steps pays ONE
+            # dispatch, so this number is stable across tunnel windows in a
+            # way the per-step B=64 row is not
+            out["scan_samples_per_sec"] = round(S * B / dt_scan, 2)
+            out["scan_batch_size"] = B
+            out["scan_chain_len"] = S
             stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] scan bonus metric failed: {e}\n")

@@ -128,11 +128,47 @@ def run(batch_size=64, cand=5, his_len=50, title_len=50, num_news=4096,
     }
 
 
+def extend(out_path: Path) -> dict:
+    """Fill the existing artifact's sweep up to bench.py's max B (2048/4096)
+    without re-measuring rows that already exist (ADVICE r3: bench.py's
+    sweep reached B=4096 while this one stopped at 1024, so the headline
+    ratio leaned on an unmeasured torch-stops-scaling assumption; until the
+    rows exist bench.py clamps the ratio to the baseline's measured range).
+    No-dedup rows at large B are minutes-per-step on this 1-core host, so
+    they run iters=1 — fine: at >100 s/step, timer noise is negligible.
+    """
+    from fedrec_tpu.utils.provenance import provenance
+
+    result = json.loads(out_path.read_text())
+    sweep = result.get("b_sweep_samples_per_sec") or {}
+    result["b_sweep_samples_per_sec"] = sweep  # attach BEFORE the loop so
+    # the per-row incremental write_text calls actually persist each row
+    # (a detached dict would make a mid-run kill lose every measured row)
+    for bsz in (2048, 4096):
+        if f"{bsz}_dedup" not in sweep:
+            r = run(batch_size=bsz, iters=2, dedup=True)
+            sweep[f"{bsz}_dedup"] = round(r["samples_per_sec"], 2)
+            out_path.write_text(json.dumps(result, indent=2))
+        if str(bsz) not in sweep:
+            r = run(batch_size=bsz, iters=1)
+            sweep[str(bsz)] = round(r["samples_per_sec"], 2)
+            out_path.write_text(json.dumps(result, indent=2))
+    result["b_sweep_samples_per_sec"] = sweep
+    result["extended_provenance"] = provenance()
+    out_path.write_text(json.dumps(result, indent=2))
+    return result
+
+
 if __name__ == "__main__":
     import sys
 
     sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
     from fedrec_tpu.utils.provenance import provenance
+
+    if "--extend" in sys.argv:
+        out = Path(__file__).parent / "baseline_host.json"
+        print(json.dumps(extend(out), indent=2))
+        sys.exit(0)
 
     result = run()
     # per-B sweep: bench.py's promoted headline divides by the baseline's

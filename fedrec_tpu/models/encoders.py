@@ -59,8 +59,11 @@ class GRUUserEncoder(nn.Module):
     permutation-equivariant over history. TPU-native by construction — the
     GRU is a ``lax.scan`` (via ``nn.RNN``), static shapes, no Python loop.
     Interchangeable with ``UserEncoder`` behind ``model.user_tower``; the
-    parameter tree differs, so snapshots are per-family (the config rides
-    with the snapshot, ``train/checkpoint.py``).
+    parameter tree differs, so snapshots are per-family: the Trainer
+    persists the resolved config as ``config.json`` next to the snapshots
+    and validates ``model.user_tower`` (and the other tree-shaping knobs)
+    against it on resume, failing with a guided message instead of a raw
+    orbax tree error (``train/trainer.py::Trainer._check_snapshot_config``).
 
     Padding semantics: with ``mask=None`` (the default every call site
     uses) tail-pad rows run through the recurrence exactly like the MHA

@@ -209,22 +209,30 @@ class Trainer:
         self.snapshots: SnapshotManager | None = None
         if snapshot_dir or cfg.train.snapshot_dir:
             self.snapshots = SnapshotManager(snapshot_dir or cfg.train.snapshot_dir)
-            try:
-                # resolved config rides with the snapshots so serving can
-                # rebuild the exact model without the operator re-typing
-                # every --set (fedrec-recommend reads it back; ADVICE r2).
-                # Atomic: a concurrently-serving fedrec-recommend must never
-                # read a torn file
-                from fedrec_tpu.train.checkpoint import atomic_write_bytes
-
-                atomic_write_bytes(
-                    self.snapshots.directory / "config.json",
-                    cfg.to_json().encode(),
-                )
-            except OSError as e:
-                print(f"[trainer] could not persist config.json: {e}")
             if cfg.train.resume and self.snapshots.latest_round() is not None:
-                self.state = self.snapshots.restore(self.state)
+                # validate BEFORE the current cfg is persisted below — the
+                # incumbent config.json is the record of what the snapshot
+                # was trained with, and must be read before being replaced
+                self._check_snapshot_config(cfg)
+                try:
+                    self.state = self.snapshots.restore(self.state)
+                except Exception as e:
+                    # the raw orbax tree-structure error names pytree paths,
+                    # not the config knob that caused them (ADVICE r3) —
+                    # name the likely culprits
+                    raise RuntimeError(
+                        f"snapshot restore from {self.snapshots.directory} "
+                        f"failed ({type(e).__name__}; chained below). If the "
+                        "error names pytree paths/shapes, the usual cause is "
+                        "a model-config change since the snapshot was "
+                        "written (model.user_tower picks a different "
+                        "parameter family; news_dim/num_heads/trunk_* change "
+                        "shapes) — compare the snapshot's config.json with "
+                        "this run's --set flags. Otherwise the checkpoint "
+                        "itself may be incomplete or corrupt; point "
+                        "train.snapshot_dir at a fresh directory to start "
+                        "over."
+                    ) from e
                 self.start_round = int(self.snapshots.latest_round()) + 1
                 print(f"[trainer] resumed from snapshot at round {self.start_round - 1}")
                 if self.server_opt is not None:
@@ -249,6 +257,22 @@ class Trainer:
                                 f"{self.start_round - 1}; momentum may be "
                                 "skewed for the first resumed round"
                             )
+            try:
+                # resolved config rides with the snapshots so serving can
+                # rebuild the exact model without the operator re-typing
+                # every --set (fedrec-recommend reads it back; ADVICE r2).
+                # Atomic: a concurrently-serving fedrec-recommend must never
+                # read a torn file. Written AFTER the resume path above so
+                # the incumbent config.json — the record of what an existing
+                # snapshot was trained with — is validated before replacement
+                from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(
+                    self.snapshots.directory / "config.json",
+                    cfg.to_json().encode(),
+                )
+            except OSError as e:
+                print(f"[trainer] could not persist config.json: {e}")
 
         self.logger = MetricLogger(
             use_wandb=cfg.train.wandb,
@@ -260,6 +284,56 @@ class Trainer:
         self.last_per_client_metrics: list[dict[str, float]] | None = None
 
     # ------------------------------------------------------------------
+    def _check_snapshot_config(self, cfg) -> None:
+        """Fail with a guided message when resuming under a model config
+        whose parameter tree cannot match the snapshot's (ADVICE r3: the
+        raw orbax tree-structure error names pytree paths, not the knob).
+        Reads the config.json the snapshot-writing run persisted; absent or
+        unreadable → silently skip (the restore itself still validates
+        structure, and older snapshot dirs predate config.json).
+        """
+        import json as _json
+
+        cfg_path = self.snapshots.directory / "config.json"
+        try:
+            saved = _json.loads(cfg_path.read_text()).get("model", {})
+        except (OSError, ValueError):
+            return
+        # the knobs that change the parameter TREE (family or shapes) —
+        # a mismatch is certain restore failure, so fail with guidance.
+        # trunk_* shape the tree only when the snapshot actually holds trunk
+        # params (text_encoder_mode="finetune", train/state.py); bert_hidden
+        # only when a text head exists (mode != "table", where news vecs are
+        # a precomputed table and no bert-width param is in the tree)
+        tree_knobs = [
+            "user_tower", "news_dim", "num_heads", "head_dim", "query_dim",
+            "text_encoder_mode",
+        ]
+        saved_mode = saved.get("text_encoder_mode")
+        if saved_mode != "table":
+            tree_knobs.append("bert_hidden")
+        if saved_mode == "finetune":
+            tree_knobs += [
+                "trunk_layers", "trunk_heads", "trunk_ffn", "trunk_vocab",
+            ]
+        diffs = [
+            (k, saved[k], getattr(cfg.model, k))
+            for k in tree_knobs
+            if k in saved and saved[k] != getattr(cfg.model, k)
+        ]
+        if diffs:
+            detail = "; ".join(
+                f"model.{k}: snapshot={s!r} vs this run={c!r}"
+                for k, s, c in diffs
+            )
+            raise ValueError(
+                f"cannot resume from {self.snapshots.directory}: the "
+                f"snapshot was trained under a different model config "
+                f"({detail}). Re-run with the snapshot's settings (its "
+                "config.json has the full record) or point "
+                "train.snapshot_dir at a fresh directory."
+            )
+
     def _client0_params(self) -> tuple[Any, Any]:
         u = jax.tree_util.tree_map(lambda x: x[0], self.state.user_params)
         n = jax.tree_util.tree_map(lambda x: x[0], self.state.news_params)

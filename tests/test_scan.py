@@ -3,7 +3,7 @@
 The scan wraps the SAME ``_build_local_step`` closure as the per-batch
 step, so the trajectories must match step for step — this is the guard
 that keeps the two programs from diverging. Dispatch-amortization itself
-is a chip property (benched as ``b64_scan_samples_per_sec``); here we pin
+is a chip property (benched as ``scan_samples_per_sec``); here we pin
 semantics on the 8-device CPU mesh.
 """
 

@@ -257,6 +257,30 @@ def test_trainer_resume_bit_identical(tmp_path):
     np.testing.assert_allclose(params_a, params_b, rtol=1e-6, atol=1e-7)
 
 
+def test_resume_wrong_user_tower_fails_with_guided_error(tmp_path):
+    """Resuming under a different model family must name the knob (ADVICE
+    r3), not surface a raw orbax tree-structure error: the Trainer persists
+    config.json with the snapshot and validates the tree-shaping knobs
+    against it before restore."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=1, train__save_every=1)
+    data, token_states = tiny_data(cfg)
+    Trainer(cfg, data, token_states).run()
+    assert (tmp_path / "config.json").exists()
+
+    cfg2 = tiny_cfg(tmp_path, fed__rounds=2, train__save_every=1)
+    cfg2.model.user_tower = "gru"
+    with pytest.raises(ValueError, match="user_tower"):
+        Trainer(cfg2, data, token_states)
+    # the incumbent config.json survives the failed resume attempt — it is
+    # the record of what the snapshot was trained with
+    import json
+
+    saved = json.loads((tmp_path / "config.json").read_text())
+    assert saved["model"]["user_tower"] == "mha"
+
+
 WORKER = textwrap.dedent(
     """
     import os, sys
