@@ -615,14 +615,16 @@ def main() -> None:
 
         # Reconcile with the same-commit incumbent once the sweep loop is
         # done trying: rows THIS run failed to re-measure (a transient
-        # wedge on one point) are merged from the PRE-RUN incumbent copy —
-        # same code, earlier window, annotated — so the final artifact is
-        # a superset and the staging guard in stamp_and_cache can never
-        # strand a finished run in .inprogress.json.
+        # wedge on one point — or ALL points: an empty sweep must still
+        # reconcile, else the staging guard keeps routing every later
+        # bonus metric to .inprogress.json, which nothing reads back) are
+        # merged from the PRE-RUN incumbent copy — same code, earlier
+        # window, annotated — so the final artifact is a superset and the
+        # staging guard in stamp_and_cache can never strand a finished
+        # run in .inprogress.json.
         try:
             if (
-                sweep
-                and incumbent0 is not None
+                incumbent0 is not None
                 and incumbent0.get("measured_commit") == out.get("measured_commit")
             ):
                 inc_sweep = incumbent0.get("b_sweep_samples_per_sec") or {}

@@ -338,9 +338,9 @@ def leg_fed(rounds: int) -> None:
         "param_avg_32_cohort": ("param_avg", 32, None, "head"),
         # second model family: recurrent (LSTUR-style) user tower
         "gru_tower_8": ("param_avg", 8, None, "head+gru"),
-        # two epsilons -> a privacy-utility tradeoff, not one crushed point
-        "param_avg_8_dp50": ("param_avg", 8, 50.0, "head"),
-        "param_avg_8_dp10": ("param_avg", 8, 10.0, "head"),
+        # DP rows live in the dedicated dp leg (leg_dp -> accuracy_dp.json):
+        # the r3 rows here trained the DP estimator with the non-DP
+        # hyperparameters and were noise-crushed to ~random (VERDICT r3 #4)
     }.items():
         cfg = ExperimentConfig()
         if strategy.endswith("+fedavgm"):
@@ -396,6 +396,97 @@ def leg_fed(rounds: int) -> None:
     }
     out["provenance"] = _prov()
     (HERE / "accuracy_fed.json").write_text(json.dumps(out, indent=2))
+
+
+def leg_dp(rounds: int) -> None:
+    """Privacy-utility sweep with DP-TUNED hyperparameters (VERDICT r3 #4).
+
+    The r3 DP rows trained the DP-SGD estimator with the non-DP recipe
+    (Adam lr 5e-4, param_avg, C=2) and landed at ~random AUC. The failure
+    mode was measured, not guessed (see docs/DP.md): per-step noise-vector
+    norm ~20x the mean-gradient norm, and Adam's second moment normalizes
+    by the NOISE scale, shrinking the per-parameter update to
+    lr * (per-param SNR) — so at lr 5e-4 the model barely moves in the
+    budgeted steps. The tuned recipe measured here:
+
+      * ``grad_avg``: the per-step pmean over 8 clients averages 8
+        INDEPENDENT noise draws — sqrt(8) noise reduction at the SAME
+        local-DP guarantee (each client noises before the collective).
+      * clip C=1.0 (just under the observed per-example norm median).
+      * Adam lr 1e-2 (the empirical optimum of the lr sweep; 2e-2
+        diverges), and the accountant budgets exactly the steps trained.
+
+    Rows: non-private anchor at the SAME tuned lr (the honest comparison
+    bar — non-DP also improves with the lr sweep) + eps in {50, 10, 3}.
+    Writes ``accuracy_dp.json``.
+    """
+    import jax
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.privacy import calibrate_from_config
+
+    data, states = _small_corpus()
+    runs = {}
+    sweep = [("nodp_tuned", None), ("dp_eps50", 50.0), ("dp_eps10", 10.0),
+             ("dp_eps3", 3.0)]
+    for name, eps in sweep:
+        cfg = ExperimentConfig()
+        cfg.model.text_encoder_mode = "head"
+        cfg.model.news_dim = 64
+        cfg.model.num_heads = 8
+        cfg.model.head_dim = 8
+        cfg.model.query_dim = 32
+        cfg.model.bert_hidden = 96
+        cfg.data.max_title_len = 12
+        cfg.data.max_his_len = 20
+        cfg.fed.strategy = "grad_avg"
+        cfg.fed.num_clients = 8
+        cfg.fed.rounds = rounds
+        cfg.optim.user_lr = cfg.optim.news_lr = 1e-2
+        cfg.train.eval_protocol = "full"
+        cfg.train.eval_every = 1
+        cfg.train.snapshot_dir = ""
+        cfg.train.resume = False
+        if eps is not None:
+            cfg.privacy.enabled = True
+            cfg.privacy.epsilon = eps
+            cfg.privacy.clip_norm = 1.0
+            # budget the accountant for the steps this run actually takes
+            cfg.privacy.accountant_epochs = rounds * cfg.fed.local_epochs
+            cfg.privacy.sigma = calibrate_from_config(
+                cfg, len(data.train_samples)
+            )
+        runs[name] = _train(cfg, data, states)
+        runs[name]["epsilon"] = eps
+        runs[name]["sigma"] = round(cfg.privacy.sigma, 4) if eps else 0.0
+        print(f"[dp] {name}: final "
+              f"{runs[name]['curve'][-1] if runs[name]['curve'] else '?'}")
+
+    anchor = runs["nodp_tuned"]["curve"][-1]["auc"]
+    out = {
+        "leg": "dp",
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "corpus": {
+            "num_news": data.num_news,
+            "train": len(data.train_samples),
+            "valid": len(data.valid_samples),
+            "bert_hidden": 96,
+        },
+        "recipe": {
+            "strategy": "grad_avg", "clients": 8, "clip_norm": 1.0,
+            "lr": 1e-2, "rounds": rounds, "delta": 1e-5,
+        },
+        "oracle_auc": round(oracle_auc(data, states), 4),
+        "nodp_anchor_auc": anchor,
+        "runs": runs,
+        "gap_to_anchor": {
+            n: round(anchor - r["curve"][-1]["auc"], 4)
+            for n, r in runs.items() if n != "nodp_tuned" and r["curve"]
+        },
+    }
+    out["provenance"] = _prov()
+    (HERE / "accuracy_dp.json").write_text(json.dumps(out, indent=2))
 
 
 def leg_adressa(rounds: int) -> None:
@@ -569,18 +660,20 @@ def _partial_note(leg: dict) -> str:
 def write_report() -> None:
     """Collect whichever leg JSONs exist into RESULTS.md (a wedged TPU
     tunnel can leave one leg missing — report the evidence that exists)."""
-    central = fed = adressa = finetune = bf16 = None
+    central = fed = dp = adressa = finetune = bf16 = None
     if (HERE / "accuracy_central.json").exists():
         central = json.loads((HERE / "accuracy_central.json").read_text())
     if (HERE / "accuracy_fed.json").exists():
         fed = json.loads((HERE / "accuracy_fed.json").read_text())
+    if (HERE / "accuracy_dp.json").exists():
+        dp = json.loads((HERE / "accuracy_dp.json").read_text())
     if (HERE / "accuracy_adressa.json").exists():
         adressa = json.loads((HERE / "accuracy_adressa.json").read_text())
     if (HERE / "accuracy_finetune.json").exists():
         finetune = json.loads((HERE / "accuracy_finetune.json").read_text())
     if (HERE / "accuracy_bf16.json").exists():
         bf16 = json.loads((HERE / "accuracy_bf16.json").read_text())
-    if all(x is None for x in (central, fed, adressa, finetune, bf16)):
+    if all(x is None for x in (central, fed, dp, adressa, finetune, bf16)):
         raise SystemExit("no accuracy_*.json found; run the legs first")
 
     lines = [
@@ -654,6 +747,36 @@ def write_report() -> None:
                 "artifact: the same 32-client run on 32 devices computes",
                 "bit-equal collectives.",
             ]
+    if dp is not None:
+        r = dp["recipe"]
+        lines += [
+            "",
+            "## 2b. Privacy-utility tradeoff (DP-tuned recipe)",
+            "",
+            f"DP-SGD sweep with hyperparameters tuned FOR the DP estimator",
+            f"(`{r['strategy']}`, {r['clients']} clients, clip C={r['clip_norm']},",
+            f"Adam lr {r['lr']}, {r['rounds']} rounds; accountant budgets the",
+            f"steps actually trained, delta={r['delta']}). The non-private",
+            "anchor uses the SAME tuned lr — the honest bar, since non-DP",
+            "training also improves under the lr sweep. Why the r3 rows were",
+            "~random and what changed: docs/DP.md.",
+            "",
+            "| run | epsilon | sigma | final AUC | gap to non-DP |",
+            "|---|---|---|---|---|",
+        ]
+        for name, run in dp["runs"].items():
+            c = run["curve"][-1] if run["curve"] else {}
+            gap = dp["gap_to_anchor"].get(name)
+            lines.append(
+                f"| {name} | {run.get('epsilon') or '—'} | {run.get('sigma', 0)} "
+                f"| {c.get('auc', float('nan')):.4f} "
+                f"| {f'{gap:+.4f}' if gap is not None else '—'} |"
+            )
+        lines += [
+            "",
+            f"Oracle AUC {dp['oracle_auc']:.4f}; non-DP tuned anchor "
+            f"{dp['nodp_anchor_auc']:.4f}.",
+        ]
     if adressa is not None:
         lines += [
             "",
@@ -735,11 +858,12 @@ def write_report() -> None:
 # --------------------------------------------------------------------- main
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--leg", choices=["central", "fed", "adressa", "finetune",
-                                     "bf16", "report"])
+    p.add_argument("--leg", choices=["central", "fed", "dp", "adressa",
+                                     "finetune", "bf16", "report"])
     p.add_argument("--all", action="store_true")
     p.add_argument("--rounds", type=int, default=16)
     p.add_argument("--fed-rounds", type=int, default=10)
+    p.add_argument("--dp-rounds", type=int, default=16)
     p.add_argument("--adressa-rounds", type=int, default=10)
     p.add_argument("--finetune-rounds", type=int, default=12)
     p.add_argument("--bf16-rounds", type=int, default=8)
@@ -792,6 +916,8 @@ def main() -> int:
         for cmd, env in (
             ([sys.executable, me, "--leg", "fed", "--rounds", str(args.fed_rounds)],
              env_fed),
+            ([sys.executable, me, "--leg", "dp",
+              "--dp-rounds", str(args.dp_rounds)], env_fed),
             ([sys.executable, me, "--leg", "adressa",
               "--rounds", str(args.adressa_rounds)], env_fed),
             ([sys.executable, me, "--leg", "finetune",
@@ -833,7 +959,7 @@ def main() -> int:
         ).returncode
 
     if (
-        args.leg in ("fed", "adressa", "finetune")
+        args.leg in ("fed", "dp", "adressa", "finetune")
         and os.environ.get("FEDREC_ACC_INNER") != "1"
     ):
         # These legs are DESIGNED for the 8-device fake CPU mesh (the
@@ -855,6 +981,8 @@ def main() -> int:
         leg_bf16(args.bf16_rounds)
     elif args.leg == "fed":
         leg_fed(args.rounds)
+    elif args.leg == "dp":
+        leg_dp(args.dp_rounds)
     elif args.leg == "adressa":
         leg_adressa(args.rounds)
     elif args.leg == "finetune":

@@ -1,11 +1,21 @@
-"""Decompose the flagship joint train step's time on the real chip.
+"""Decompose the flagship joint train step's time on the real chip — and
+turn it into a roofline verdict (VERDICT r3 #2).
 
-Times each component of the B=64 joint step with the tunnel-honest chain
-timer (``pallas_bench._time``): token-state gather, unique-ids dedup, text
-tower fwd / fwd+bwd, user tower fwd / fwd+bwd, loss+optimizer, and the full
-step — so perf work aims at the measured bottleneck instead of the analytic
-FLOPs model (which says text-tower matmuls dominate; MFU 0.20 says ~2.5x is
-being lost somewhere).
+Times each component of the joint step with the tunnel-honest chain timer
+(``pallas_bench._time``) at B=64 (the flagship continuity point) AND at the
+throughput-optimal B=1024: token-state gather, unique-ids dedup, text tower
+fwd / fwd+bwd, user tower fwd / fwd+bwd, and the full step. For the full
+step it also computes an explicit FLOPs + HBM-bytes model and reports, per
+batch size:
+
+  * achieved FLOP/s as a fraction of the chip's matmul peak (the MFU), and
+  * achieved HBM GB/s as a fraction of peak bandwidth,
+
+so the artifact SAYS whether the 0.11–0.23 MFU window is a memory-bound
+ceiling (bandwidth fraction high) or unclaimed headroom (both fractions
+low → dispatch/latency/fusion problem). Assumptions of the bytes model are
+recorded in the artifact: token states read twice (fwd + bwd recompute),
+activations touched twice, params+opt-state read+written once per step.
 
 Run on TPU:  python benchmarks/step_profile.py
 """
@@ -23,6 +33,15 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from pallas_bench import _time  # noqa: E402  (same honest timer)
+
+# chip-name fragment -> (bf16 peak FLOP/s, f32 peak FLOP/s, HBM GB/s)
+_PEAKS = {
+    "v5 lite": (197e12, 49e12, 819e9),
+    "v5e": (197e12, 49e12, 819e9),
+    "v4": (275e12, 137e12, 1228e9),
+    "v5p": (459e12, 229e12, 2765e9),
+    "v6": (918e12, 459e12, 1640e9),
+}
 
 
 def main() -> int:
@@ -50,110 +69,182 @@ def main() -> int:
     cfg = ExperimentConfig()
     cfg.model.dtype = "float32" if on_cpu else "bfloat16"
     num_news, L = 4096, cfg.data.max_title_len
-    B, C, H = 64, 1 + cfg.data.npratio, cfg.data.max_his_len
-    Dh = cfg.model.bert_hidden
+    C, H = 1 + cfg.data.npratio, cfg.data.max_his_len
+    Dh, D = cfg.model.bert_hidden, cfg.model.news_dim
+    dt_bytes = 4 if cfg.model.dtype == "float32" else 2
 
     rng = np.random.default_rng(0)
     token_states = jnp.asarray(
         rng.standard_normal((num_news, L, Dh), dtype=np.float32),
         jnp.dtype(cfg.model.dtype),
     )
-    candidates = jnp.asarray(rng.integers(0, num_news, (B, C)).astype(np.int32))
-    history = jnp.asarray(rng.integers(0, num_news, (B, H)).astype(np.int32))
-    labels = jnp.zeros((B,), jnp.int32)
-
     model = NewsRecommender(cfg.model)
-    dummy_states = token_states[:1]
-    dummy_cand = jnp.zeros((1, C, cfg.model.news_dim), jnp.dtype(cfg.model.dtype))
-    dummy_his = jnp.zeros((1, H, cfg.model.news_dim), jnp.dtype(cfg.model.dtype))
+    dummy_cand = jnp.zeros((1, C, D), jnp.dtype(cfg.model.dtype))
+    dummy_his = jnp.zeros((1, H, D), jnp.dtype(cfg.model.dtype))
     variables = model.init(
-        jax.random.PRNGKey(0), dummy_states, dummy_cand, dummy_his,
+        jax.random.PRNGKey(0), token_states[:1], dummy_cand, dummy_his,
         method=NewsRecommender.init_both_towers,
     )
     text_p = variables["params"]["text_head"]
     user_p = variables["params"]["user_encoder"]
-
-    size = B * (C + H)
-    flat_ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
-
-    # ---- components (first arg is the one _time perturbs/chains on)
-    def gather_only(ts):
-        uniq, inv = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
-                               return_inverse=True)
-        return ts[uniq].sum()
-
-    def unique_only(ids_f32):
-        # ids passed as float so the chain perturbation type-checks; cast back
-        uniq, inv = jnp.unique(ids_f32.astype(jnp.int32), size=min(size, num_news),
-                               fill_value=0, return_inverse=True)
-        return uniq.sum() + inv.sum()
-
-    def text_fwd(ts):
-        uniq, _ = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
-                             return_inverse=True)
-        return model.apply({"params": {"text_head": text_p}}, ts[uniq],
-                           method=NewsRecommender.encode_news).sum()
-
-    def text_fwd_bwd(ts):
-        def loss(p):
-            uniq, _ = jnp.unique(flat_ids, size=min(size, num_news), fill_value=0,
-                                 return_inverse=True)
-            return model.apply({"params": {"text_head": p}}, ts[uniq],
-                               method=NewsRecommender.encode_news).sum()
-        g = jax.grad(loss)(text_p)
-        # sum EVERY leaf: a single bias-grad leaf can be input-independent,
-        # letting XLA fold the whole chained body to a constant (times ~0)
-        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-    cand_vecs, his_vecs = _batch_news_vecs(
-        model, text_p, token_states, candidates, history
+    n_params = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves((text_p, user_p))
     )
 
-    def user_fwd(cv):
-        scores = model.apply({"params": {"user_encoder": user_p}}, cv, his_vecs)
-        return scores.sum()
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    peaks = next((v for f, v in _PEAKS.items() if f in kind), None)
 
-    def user_fwd_bwd(cv):
-        def loss(p):
-            scores = model.apply({"params": {"user_encoder": p}}, cv, his_vecs)
-            return score_loss(scores, labels)
-        g = jax.grad(loss)(user_p)
-        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+    # THE flops model is bench.py's — imported, not duplicated, so the
+    # roofline 'mfu' here and the headline 'mfu_estimate' there can never
+    # drift apart
+    from bench import _flops_per_train_step
 
-    def full_fwd_bwd(ts):
-        def loss(ps):
-            cv, hv = _batch_news_vecs(model, ps["text"], ts, candidates, history)
-            scores = model.apply({"params": {"user_encoder": ps["user"]}}, cv, hv)
-            return score_loss(scores, labels)
-        g = jax.grad(loss)({"text": text_p, "user": user_p})
-        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+    def flops_of(B: int, U: int) -> float:
+        return _flops_per_train_step(cfg, B, num_news)
 
-    def full_fwd_bwd_capped(ts):
-        # the FLAGSHIP configuration: unique-news cap 2560 (bench.py)
-        def loss(ps):
-            cv, hv = _batch_news_vecs(
-                model, ps["text"], ts, candidates, history, cap=2560
+    def bytes_of(B: int, U: int) -> float:
+        """HBM traffic model for the full fwd+bwd step (assumptions in the
+        module docstring; recorded in the artifact)."""
+        token_reads = 2 * U * L * Dh * dt_bytes          # fwd + bwd recompute
+        text_acts = 2 * U * (L * att_hidden_bytes() + D * dt_bytes)
+        user_acts = 2 * B * (C + H) * D * dt_bytes * 3   # vecs, attn ctx, pool
+        opt = n_params * 4 * 2 * 3                       # p, m, v read+write f32
+        return token_reads + text_acts + user_acts + opt
+
+    def att_hidden_bytes() -> int:
+        return (Dh // 2) * dt_bytes
+
+    out_all = {}
+    batches = (64,) if on_cpu else (64, 1024)
+    for B in batches:
+        candidates = jnp.asarray(
+            rng.integers(0, num_news, (B, C)).astype(np.int32)
+        )
+        history = jnp.asarray(
+            rng.integers(0, num_news, (B, H)).astype(np.int32)
+        )
+        labels = jnp.zeros((B,), jnp.int32)
+        size = B * (C + H)
+        U = min(size, num_news)
+        flat_ids = jnp.concatenate(
+            [candidates.reshape(-1), history.reshape(-1)]
+        )
+
+        # ---- components (first arg is the one _time perturbs/chains on)
+        def gather_only(ts):
+            uniq, inv = jnp.unique(flat_ids, size=U, fill_value=0,
+                                   return_inverse=True)
+            return ts[uniq].sum()
+
+        def unique_only(ids_f32):
+            # float so the chain perturbation type-checks; cast back
+            uniq, inv = jnp.unique(ids_f32.astype(jnp.int32), size=U,
+                                   fill_value=0, return_inverse=True)
+            return uniq.sum() + inv.sum()
+
+        def text_fwd(ts):
+            uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
+                                 return_inverse=True)
+            return model.apply({"params": {"text_head": text_p}}, ts[uniq],
+                               method=NewsRecommender.encode_news).sum()
+
+        def text_fwd_bwd(ts):
+            def loss(p):
+                uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
+                                     return_inverse=True)
+                return model.apply({"params": {"text_head": p}}, ts[uniq],
+                                   method=NewsRecommender.encode_news).sum()
+            g = jax.grad(loss)(text_p)
+            # sum EVERY leaf: a single bias-grad leaf can be input-
+            # independent, letting XLA fold the chained body to a constant
+            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+        cand_vecs, his_vecs = _batch_news_vecs(
+            model, text_p, token_states, candidates, history
+        )
+
+        def user_fwd(cv):
+            return model.apply(
+                {"params": {"user_encoder": user_p}}, cv, his_vecs
+            ).sum()
+
+        def user_fwd_bwd(cv):
+            def loss(p):
+                scores = model.apply(
+                    {"params": {"user_encoder": p}}, cv, his_vecs
+                )
+                return score_loss(scores, labels)
+            g = jax.grad(loss)(user_p)
+            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+        def full_fwd_bwd(ts):
+            def loss(ps):
+                cv, hv = _batch_news_vecs(
+                    model, ps["text"], ts, candidates, history
+                )
+                scores = model.apply(
+                    {"params": {"user_encoder": ps["user"]}}, cv, hv
+                )
+                return score_loss(scores, labels)
+            g = jax.grad(loss)({"text": text_p, "user": user_p})
+            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+        comps = {
+            "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
+            "gather_only": (gather_only, token_states),
+            "text_fwd": (text_fwd, token_states),
+            "text_fwd_bwd": (text_fwd_bwd, token_states),
+            "user_fwd": (user_fwd, cand_vecs),
+            "user_fwd_bwd": (user_fwd_bwd, cand_vecs),
+            "full_fwd_bwd": (full_fwd_bwd, token_states),
+        }
+        if B == 64:
+            def full_fwd_bwd_capped(ts):
+                # the FLAGSHIP configuration: unique-news cap 2560 (bench.py)
+                def loss(ps):
+                    cv, hv = _batch_news_vecs(
+                        model, ps["text"], ts, candidates, history, cap=2560
+                    )
+                    scores = model.apply(
+                        {"params": {"user_encoder": ps["user"]}}, cv, hv
+                    )
+                    return score_loss(scores, labels)
+                g = jax.grad(loss)({"text": text_p, "user": user_p})
+                return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+            comps["full_fwd_bwd_capped"] = (full_fwd_bwd_capped, token_states)
+
+        res = {}
+        for name, (fn, arg0) in comps.items():
+            t = _time(jax.jit(fn), arg0, iters=3 if on_cpu else 30)
+            res[name] = round(t * 1e3, 4)
+            print(f"B={B:5d} {name:22s} {t*1e3:9.3f} ms", flush=True)
+
+        entry = {"components_ms": res}
+        # roofline for the full step at this B
+        t_full = res["full_fwd_bwd"] / 1e3
+        fl, by = flops_of(B, U), bytes_of(B, U)
+        entry["model_flops"] = fl
+        entry["model_hbm_bytes"] = by
+        entry["arithmetic_intensity"] = round(fl / by, 2)
+        if peaks is not None:
+            peak_fl = peaks[0] if cfg.model.dtype == "bfloat16" else peaks[1]
+            peak_bw = peaks[2]
+            entry["mfu"] = round(fl / t_full / peak_fl, 4)
+            entry["hbm_fraction"] = round(by / t_full / peak_bw, 4)
+            entry["ridge_intensity"] = round(peak_fl / peak_bw, 1)
+            bound = (
+                "memory-bound" if entry["hbm_fraction"] >= 0.6
+                else "compute-bound" if entry["mfu"] >= 0.6
+                else "neither peak approached: dispatch/latency/fusion "
+                     "headroom"
             )
-            scores = model.apply({"params": {"user_encoder": ps["user"]}}, cv, hv)
-            return score_loss(scores, labels)
-        g = jax.grad(loss)({"text": text_p, "user": user_p})
-        return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-    comps = {
-        "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
-        "gather_only": (gather_only, token_states),
-        "text_fwd": (text_fwd, token_states),
-        "text_fwd_bwd": (text_fwd_bwd, token_states),
-        "user_fwd": (user_fwd, cand_vecs),
-        "user_fwd_bwd": (user_fwd_bwd, cand_vecs),
-        "full_fwd_bwd": (full_fwd_bwd, token_states),
-        "full_fwd_bwd_capped": (full_fwd_bwd_capped, token_states),
-    }
-    out = {}
-    for name, (fn, arg0) in comps.items():
-        t = _time(jax.jit(fn), arg0, iters=3 if on_cpu else 30)
-        out[name] = round(t * 1e3, 4)
-        print(f"{name:20s} {t*1e3:9.3f} ms", flush=True)
+            entry["verdict"] = bound
+            print(f"B={B:5d} roofline: MFU {entry['mfu']:.3f}, "
+                  f"HBM {entry['hbm_fraction']:.3f} of peak -> {bound}",
+                  flush=True)
+        out_all[str(B)] = entry
 
     from fedrec_tpu.utils.provenance import provenance
 
@@ -161,9 +252,17 @@ def main() -> int:
     # gets shadowed (and vice versa)
     name = "step_profile_cpu.json" if on_cpu else "step_profile.json"
     Path(__file__).with_name(name).write_text(
-        json.dumps({"B": B, "dtype": cfg.model.dtype,
-                    "components_ms": out,
-                    "provenance": provenance()}, indent=2)
+        json.dumps({
+            "dtype": cfg.model.dtype,
+            "batches": out_all,
+            "bytes_model_assumptions": (
+                "token states read 2x (fwd + bwd recompute); text/user "
+                "activations touched 2x; params + Adam moments read+written "
+                "in f32; weight reads ignored (resident); gather index "
+                "traffic ignored"
+            ),
+            "provenance": provenance(),
+        }, indent=2)
     )
     return 0
 

@@ -136,7 +136,11 @@ def main(argv: list[str] | None = None) -> int:
         # identical; client 0 is the convention, Trainer._client0_params)
         raw = snapshots.restore_raw()
         snapshots.close()
-        client0 = jax.tree_util.tree_map(lambda x: jnp.asarray(x[0]), raw)
+        # HOST arrays, not jnp: an orbax restore can carry the TRAINING
+        # run's device placement (e.g. a 4-client mesh), which conflicts
+        # with the serving mesh when build_recommend_fn_sharded spans all
+        # local devices — let the jitted scorer place them instead
+        client0 = jax.tree_util.tree_map(lambda x: np.asarray(x[0]), raw)
         user_params, news_params = client0["user_params"], client0["news_params"]
     elif globals_:
         snapshots.close()
@@ -155,8 +159,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"[recommend] coordinator globals vanished under {snap_dir}; "
                   "retry", file=sys.stderr)
             return 2
-        user_params = jax.tree_util.tree_map(jnp.asarray, raw["user"])
-        news_params = jax.tree_util.tree_map(jnp.asarray, raw["news"])
+        # host arrays for the same reason as the orbax path above
+        user_params = jax.tree_util.tree_map(np.asarray, raw["user"])
+        news_params = jax.tree_util.tree_map(np.asarray, raw["news"])
         print(f"[recommend] serving coordinator global round {raw['round']}",
               file=sys.stderr)
     else:
@@ -218,10 +223,24 @@ def main(argv: list[str] | None = None) -> int:
     # reference demo shard: 225 rows, 139 ids) — never recommend the unmapped
     valid = np.zeros(data.num_news, bool)
     valid[[i for i in index2nid if 0 <= i < data.num_news]] = True
-    fn = build_recommend_fn(
-        model, top_k=args.top_k,
-        exclude_history=not args.keep_history, valid_mask=valid,
-    )
+    if len(jax.devices()) > 1:
+        # ride the mesh: catalog + score matrix sharded over every device,
+        # local top-k + all_gather merge (serve.build_recommend_fn_sharded)
+        from fedrec_tpu.parallel import client_mesh
+        from fedrec_tpu.serve import build_recommend_fn_sharded
+
+        mesh = client_mesh(len(jax.devices()))
+        fn = build_recommend_fn_sharded(
+            model, mesh, top_k=args.top_k,
+            exclude_history=not args.keep_history, valid_mask=valid,
+        )
+        print(f"[recommend] catalog scoring sharded over {mesh.size} devices",
+              file=sys.stderr)
+    else:
+        fn = build_recommend_fn(
+            model, top_k=args.top_k,
+            exclude_history=not args.keep_history, valid_mask=valid,
+        )
 
     out_fh = sys.stdout if args.out == "-" else open(args.out, "w")
     h_len = cfg.data.max_his_len

@@ -93,9 +93,13 @@ class ModelConfig:
     # Route hot ops through the Pallas kernels. EXPERIMENTAL OPT-IN: at every
     # chip-measured size so far the XLA dense path wins (20-dim heads pad to
     # 128 lanes; benchmarks/pallas_bench.json), so 'auto' NEVER selects
-    # pallas unless this flag is set. The kernels now carry a blocked O(L)
-    # FlashAttention-2 backward; re-judge on the H>=2048 rows of the next
-    # chip run of benchmarks/pallas_bench.py before promoting.
+    # pallas unless this flag is set. In the one regime needing O(L)
+    # attention — training at H>=2048, dense fwd+bwd OOM — the r3 chip
+    # window measured pallas AHEAD of the chunked scan (255 vs 299 ms
+    # fwd+bwd at H=2048), so this opt-in is the measured-better choice
+    # there. The kernels were restructured since (grid-streamed K/V,
+    # VMEM scratch accumulators, input-dtype MXU dots); re-judge on the
+    # queued re-bench before promoting into 'auto'.
     use_pallas: bool = False
     # user-encoder self-attention implementation:
     #   "auto"    — dense XLA up to attn_chunk_threshold history items, then

@@ -32,6 +32,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128
 _SUBLANE = 8
@@ -54,44 +55,58 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
 
 # ============================================================ flash attention
 def _flash_kernel(
-    q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int, scale: float
+    q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref, *, scale: float,
 ):
-    """One (batch*head, q-block) program: online softmax over key blocks.
+    """One (batch*head, q-block, k-block) grid step of the online softmax.
 
-    q_ref: (1, block_q, dk)   k_ref/v_ref: (1, L_pad, dk)   bias: (1, 1, L_pad)
-    Also writes the per-row log-sum-exp (``lse_ref``: (1, 1, block_q)) — the
+    K/V stream through the GRID's innermost dimension — one (block_k, dk)
+    tile in VMEM at a time, double-buffered by the pipeline — instead of
+    the whole (L, dk) K/V residing per program (the r3 kernel's layout:
+    it serialized a full-L HBM->VMEM copy before any compute and its
+    remote compile failed outright at L=4096). Running softmax state
+    (m/l/acc) lives in VMEM scratch across k-steps; outputs are written on
+    the last k-step. The dots run in the INPUT dtype with f32
+    accumulation (``preferred_element_type``) — on bf16 models that is
+    the MXU's native 4x-rate path, where the old kernel upcast everything
+    to f32 first.
+
+    q_ref: (1, block_q, dk)  k_ref/v_ref: (1, block_k, dk)
+    bias_ref: (1, 1, block_k)  lse_ref: (1, 1, block_q) log-sum-exp — the
     residual the blocked backward needs to rebuild p without a dense pass.
     """
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, dk)
-    l_pad = k_ref.shape[1]
-    block_q = q.shape[0]
-    dv = v_ref.shape[2]
+    j = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, dv), jnp.float32)
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        b = bias_ref[0, 0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + b[None, :]                                   # (bq, bk)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+    q = q_ref[0]                                         # (bq, dk) input dtype
+    k = k_ref[0]
+    v = v_ref[0]
+    b = bias_ref[0, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale + b[None, :]                               # (bq, bk) f32
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_ref[:, :1] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:, :1] = m_new
 
-    m, l, acc = jax.lax.fori_loop(0, l_pad // block_k, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m + jnp.log(l_safe))[:, 0]
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_ref[:, :1] + jnp.log(l_safe))[:, 0]
 
 
 def _flash_pad(q, k, v, bias, block_q, block_k):
@@ -121,23 +136,32 @@ def _flash_forward(
     scale = 1.0 / (dk ** 0.5)
     qp, kp, vp, biasp = _flash_pad(q, k, v, bias, block_q, block_k)
     lq_pad, lk_pad = qp.shape[1], kp.shape[1]
-    grid = (bh, lq_pad // block_q)
+    dkp, dvp = qp.shape[2], vp.shape[2]
+    grid = (bh, lq_pad // block_q, lk_pad // block_k)
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, block_k=block_k, scale=scale),
+        functools.partial(_flash_kernel, scale=scale),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, lq_pad, vp.shape[2]), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq_pad, dvp), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, lq_pad), jnp.float32),
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk_pad, kp.shape[2]), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk_pad, vp.shape[2]), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, lk_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dkp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dkp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, vp.shape[2]), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, dvp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dvp), jnp.float32),      # acc
+            pltpu.VMEM((block_q, _LANE), jnp.float32),    # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),    # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
     )(qp, kp, vp, biasp)
@@ -146,85 +170,88 @@ def _flash_forward(
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, bias_ref, do_ref, delta_ref, lse_ref, dq_ref,
-    *, block_k: int, scale: float,
+    acc_ref, *, scale: float,
 ):
-    """dq for one (batch*head, q-block): stream key blocks, rebuild p from
-    the saved log-sum-exp (FlashAttention-2 backward, q-parallel half)."""
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    """dq, one (batch*head, q-block, k-block) grid step: K/V stream through
+    the grid, p is rebuilt from the saved log-sum-exp (FlashAttention-2
+    backward, q-parallel half). Accumulates into VMEM scratch; dq is
+    written on the last k-step."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                         # (bq, dk) input dtype
+    do = do_ref[0]
     lse = lse_ref[0, 0, :].astype(jnp.float32)           # (bq,)
     delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]  # (bq, 1)
-    l_pad = k_ref.shape[1]
-    acc0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
+    b = bias_ref[0, 0, :].astype(jnp.float32)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + b[None, :]
+    p = jnp.exp(s - lse[:, None])                        # (bq, bk) f32
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = (p * (dp - delta)).astype(k.dtype)
+    acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
 
-    def body(i, acc):
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        b = bias_ref[0, 0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + b[None, :]
-        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    acc = jax.lax.fori_loop(0, l_pad // block_k, body, acc0)
-    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(
     k_ref, v_ref, bias_ref, q_ref, do_ref, delta_ref, lse_ref,
-    dk_ref, dv_ref, dbias_ref,
-    *, block_q: int, scale: float,
+    dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, db_acc, *, scale: float,
 ):
-    """dk/dv/dbias for one (batch*head, k-block): stream query blocks
-    (FlashAttention-2 backward, k-parallel half)."""
-    k = k_ref[0].astype(jnp.float32)                     # (bk, dk)
-    v = v_ref[0].astype(jnp.float32)
+    """dk/dv/dbias, one (batch*head, k-block, q-block) grid step: query
+    blocks stream through the grid (FlashAttention-2 backward, k-parallel
+    half). Accumulates in VMEM scratch; outputs written on the last
+    q-step."""
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    k = k_ref[0]                                         # (bk, dk) input dtype
+    v = v_ref[0]
     b = bias_ref[0, 0, :].astype(jnp.float32)            # (bk,)
-    lq_pad = q_ref.shape[1]
-    block_k, dk_dim = k.shape
-    dv_dim = v.shape[1]
-    init = (
-        jnp.zeros((block_k, dk_dim), jnp.float32),
-        jnp.zeros((block_k, dv_dim), jnp.float32),
-        jnp.zeros((1, block_k), jnp.float32),
+    q = q_ref[0]                                         # (bq, dk)
+    do = do_ref[0]
+    lse = lse_ref[0, 0, :].astype(jnp.float32)
+    delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]  # (bq, 1)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + b[None, :]                                       # (bq, bk)
+    p = jnp.exp(s - lse[:, None])
+    pc = p.astype(do.dtype)
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    dsc = ds.astype(q.dtype)
+    dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+        dsc, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    db_acc[:, :] = db_acc[:, :] + jnp.sum(ds, axis=0)[None, :]
 
-    def body(i, carry):
-        dk_acc, dv_acc, db_acc = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
-            jnp.float32
-        )[:, None]                                       # (bq, 1)
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + b[None, :]                                   # (bq, bk)
-        p = jnp.exp(s - lse[:, None])
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        dk_acc = dk_acc + scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        db_acc = db_acc + jnp.sum(ds, axis=0)[None, :]
-        return dk_acc, dv_acc, db_acc
-
-    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(0, lq_pad // block_q, body, init)
-    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
-    dbias_ref[0, 0, :] = db_acc[0].astype(dbias_ref.dtype)
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dbias_ref[0, 0, :] = db_acc[0, :].astype(dbias_ref.dtype)
 
 
 def _attention_dense(q, k, v, bias):
@@ -271,43 +298,57 @@ def _flash_bwd(block_q, block_k, res, g):
     dkp_dim, dvp_dim = kp.shape[2], vp.shape[2]
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel, scale=scale),
         out_shape=jax.ShapeDtypeStruct((bh, lq_pad, qp.shape[2]), q.dtype),
-        grid=(bh, lq_pad // block_q),
+        grid=(bh, lq_pad // block_q, lk_pad // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk_pad, dkp_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk_pad, dvp_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, lk_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, dvp_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dkp_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, block_q, dvp_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec(
+            (1, block_q, qp.shape[2]), lambda b, i, j: (b, i, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, qp.shape[2]), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=_interpret(),
     )(qp, kp, vp, biasp, dop, deltap, lsep)
 
     dk, dv, dbias = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, scale=scale),
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale),
         out_shape=(
             jax.ShapeDtypeStruct((bh, lk_pad, dkp_dim), k.dtype),
             jax.ShapeDtypeStruct((bh, lk_pad, dvp_dim), v.dtype),
             jax.ShapeDtypeStruct((bh, 1, lk_pad), bias.dtype),
         ),
-        grid=(bh, lk_pad // block_k),
+        grid=(bh, lk_pad // block_k, lq_pad // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
-            pl.BlockSpec((1, lq_pad, qp.shape[2]), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, lq_pad, dvp_dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, lq_pad), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, lq_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec((1, block_q, qp.shape[2]), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dvp_dim), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((1, block_k, dkp_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dvp_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dkp_dim), jnp.float32),
+            pltpu.VMEM((block_k, dvp_dim), jnp.float32),
+            pltpu.VMEM((1, block_k), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
     )(kp, vp, biasp, qp, dop, deltap, lsep)

@@ -103,16 +103,23 @@ def test_recommend_cli_after_training(tmp_path):
     assert denied.returncode == 2
     assert "no token states" in denied.stderr
 
+    # serve on an EIGHT-device mesh against the 2-client training snapshot:
+    # covers the sharded scorer CLI branch AND the mesh-mismatch regression
+    # (restored params must come back as host arrays, not arrays committed
+    # to the training run's smaller device set — fedrec_tpu/cli/recommend.py)
+    env8 = cpu_host_env(8)
+    env8["PYTHONPATH"] = REPO + os.pathsep + env8.get("PYTHONPATH", "")
     proc = subprocess.run(
         [sys.executable, "-m", "fedrec_tpu.cli.recommend",
          "--data-dir", shard, "--snapshot-dir", str(tmp_path / "snapshots"),
          "--top-k", "5", "--out", str(out_path), "--allow-random-states",
          *common],
-        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
+        env=env8, cwd=tmp_path, capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     # the training run persisted its resolved config; serving must use it
     assert "using training config" in proc.stderr
+    assert "sharded over 8 devices" in proc.stderr
 
     import pickle
     with open(Path(shard) / "bert_nid2index.pkl", "rb") as f:
