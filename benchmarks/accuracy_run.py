@@ -321,23 +321,23 @@ def leg_fed(rounds: int) -> None:
 
     data, states = _small_corpus()
     runs = {}
-    for name, (strategy, clients, dp_eps, mode) in {
-        "local_1client": ("local", 1, None, "head"),
+    for name, (strategy, clients, mode) in {
+        "local_1client": ("local", 1, "head"),
         # the reference's actual epoch structure: user tower trains on a
         # precomputed news-vec table, text head updates from accumulated
         # embedding grads at epoch end (reference model.py:66-90)
-        "decoupled_1client": ("local", 1, None, "table"),
-        "param_avg_8": ("param_avg", 8, None, "head"),
+        "decoupled_1client": ("local", 1, "table"),
+        "param_avg_8": ("param_avg", 8, "head"),
         # FedAvgM (server momentum over round deltas, Reddi et al. 2021) —
         # beyond-parity: the reference only has the plain mean
-        "param_avg_8_fedavgm": ("param_avg+fedavgm", 8, None, "head"),
-        "grad_avg_8": ("grad_avg", 8, None, "head"),
+        "param_avg_8_fedavgm": ("param_avg+fedavgm", 8, "head"),
+        "grad_avg_8": ("grad_avg", 8, "head"),
         # BASELINE north-star client count via cohorts (32 clients on the
         # 8-device rig -> 4 per device; packing-independent semantics
         # pinned by tests/test_cohorts.py)
-        "param_avg_32_cohort": ("param_avg", 32, None, "head"),
+        "param_avg_32_cohort": ("param_avg", 32, "head"),
         # second model family: recurrent (LSTUR-style) user tower
-        "gru_tower_8": ("param_avg", 8, None, "head+gru"),
+        "gru_tower_8": ("param_avg", 8, "head+gru"),
         # DP rows live in the dedicated dp leg (leg_dp -> accuracy_dp.json):
         # the r3 rows here trained the DP estimator with the non-DP
         # hyperparameters and were noise-crushed to ~random (VERDICT r3 #4)
@@ -362,21 +362,35 @@ def leg_fed(rounds: int) -> None:
         cfg.fed.strategy = strategy
         cfg.fed.num_clients = clients
         cfg.fed.rounds = rounds
-        cfg.optim.user_lr = cfg.optim.news_lr = 5e-4  # see leg_central
+        # lr 1e-2: the r4 sweep optimum on this corpus (5e-4 -> 0.667,
+        # 1e-2 -> 0.80 for the 8-client row); one shared lr keeps the
+        # federation-mode comparison fair. Two rows run at their own
+        # measured operating points (noted in the report):
+        #   * local_1client: 1 client takes 8x the optimizer steps per
+        #     round of the federated rows, and lr 1e-2 collapses it after
+        #     round 2 (AUC 0.72 -> 0.50); its sweep optimum is 2e-3.
+        #   * param_avg_8_fedavgm: server momentum 0.9 over round deltas
+        #     produced by lr 1e-2 locals over-accelerates (0.80 -> 0.54);
+        #     momentum shines with conservative locals, so it keeps a
+        #     smaller local lr.
+        cfg.optim.user_lr = cfg.optim.news_lr = 1e-2
+        if name == "local_1client":
+            cfg.optim.user_lr = cfg.optim.news_lr = 2e-3
+        if cfg.fed.server_opt not in ("", "none"):
+            # the fedavgm row's conservative locals (server_opt's default
+            # is the STRING "none" — truthy; compare explicitly)
+            cfg.optim.user_lr = cfg.optim.news_lr = 5e-4
+        if clients == 32:
+            # step equalization (VERDICT r3 #5): a 32-client split leaves
+            # each client 1/4 the per-round local steps of the 8-client
+            # rows (250 samples -> 3 steps/epoch vs 15); 4 local epochs
+            # restores the update count, closing the gap to the 8-client
+            # row from 0.17 to ~0.006 AUC on this corpus
+            cfg.fed.local_epochs = 4
         cfg.train.eval_protocol = "full"
         cfg.train.eval_every = 1
         cfg.train.snapshot_dir = ""
         cfg.train.resume = False
-        if dp_eps is not None:
-            from fedrec_tpu.privacy import calibrate_from_config
-
-            cfg.privacy.enabled = True
-            cfg.privacy.epsilon = dp_eps
-            # budget the accountant for the steps this run actually takes —
-            # the reference hardcodes EPOCHS=50 (client.py:223), which at 10
-            # rounds over-noises by ~sqrt(5) and buries the tradeoff curve
-            cfg.privacy.accountant_epochs = rounds * cfg.fed.local_epochs
-            cfg.privacy.sigma = calibrate_from_config(cfg, len(data.train_samples))
         runs[name] = _train(cfg, data, states)
         print(f"[fed] {name}: final "
               f"{runs[name]['curve'][-1] if runs[name]['curve'] else '?'}")
@@ -414,10 +428,15 @@ def leg_dp(rounds: int) -> None:
         local-DP guarantee (each client noises before the collective).
       * clip C=1.0 (just under the observed per-example norm median).
       * Adam lr 1e-2 (the empirical optimum of the lr sweep; 2e-2
-        diverges), and the accountant budgets exactly the steps trained.
+        diverges), cosine-decayed over the full step budget — injected
+        noise variance scales with lr^2, so the small late lr averages
+        the noise out (worth +0.03 AUC at eps=50 over constant lr).
+      * 32 rounds x 2 local epochs (DP gains from more steps under decay
+        where the constant-lr run plateaus), accountant budgeting exactly
+        the steps trained.
 
-    Rows: non-private anchor at the SAME tuned lr (the honest comparison
-    bar — non-DP also improves with the lr sweep) + eps in {50, 10, 3}.
+    Rows: non-private anchor at the SAME tuned recipe (the honest
+    comparison bar — non-DP also improves under it) + eps in {50, 10, 3}.
     Writes ``accuracy_dp.json``.
     """
     import jax
@@ -442,7 +461,12 @@ def leg_dp(rounds: int) -> None:
         cfg.fed.strategy = "grad_avg"
         cfg.fed.num_clients = 8
         cfg.fed.rounds = rounds
+        cfg.fed.local_epochs = 2
         cfg.optim.user_lr = cfg.optim.news_lr = 1e-2
+        per_client = len(data.train_samples) // cfg.fed.num_clients
+        steps_per_epoch = max(per_client // cfg.data.batch_size, 1)
+        cfg.optim.lr_schedule = "cosine"
+        cfg.optim.decay_steps = steps_per_epoch * rounds * cfg.fed.local_epochs
         cfg.train.eval_protocol = "full"
         cfg.train.eval_every = 1
         cfg.train.snapshot_dir = ""
@@ -475,7 +499,8 @@ def leg_dp(rounds: int) -> None:
         },
         "recipe": {
             "strategy": "grad_avg", "clients": 8, "clip_norm": 1.0,
-            "lr": 1e-2, "rounds": rounds, "delta": 1e-5,
+            "lr": 1e-2, "lr_schedule": "cosine", "local_epochs": 2,
+            "rounds": rounds, "delta": 1e-5,
         },
         "oracle_auc": round(oracle_auc(data, states), 4),
         "nodp_anchor_auc": anchor,
@@ -741,11 +766,19 @@ def write_report() -> None:
                 "`param_avg_32_cohort` runs the BASELINE north-star client",
                 "count via in-device cohorts (32 clients on the 8-device",
                 "mesh, 4 per device; `tests/test_cohorts.py` pins the",
-                "packing-independence). Its lower AUC at an equal round",
-                "budget is standard FedAvg scaling — each client holds 1/4",
-                "the per-client data of the 8-client rows — not a cohort",
-                "artifact: the same 32-client run on 32 devices computes",
-                "bit-equal collectives.",
+                "packing-independence). It trains 4 local epochs per round:",
+                "a 32-way split leaves each client 1/4 the per-round local",
+                "steps of the 8-client rows, and equalizing the update",
+                "count closes the r3 gap (0.55 vs 0.67 then) to within",
+                "~0.006 AUC of the 8-client row — standard FedAvg data",
+                "scaling, not a cohort artifact: the same 32-client run on",
+                "32 devices computes bit-equal collectives.",
+                "",
+                "`local_1client` and `param_avg_8_fedavgm` run at their own",
+                "measured operating points (lr 2e-3 / local lr 5e-4): one",
+                "client takes 8x the optimizer steps per round, and server",
+                "momentum 0.9 over-accelerates on lr-1e-2 round deltas —",
+                "both collapse at the shared lr (see leg_fed comments).",
             ]
     if dp is not None:
         r = dp["recipe"]
@@ -863,7 +896,7 @@ def main() -> int:
     p.add_argument("--all", action="store_true")
     p.add_argument("--rounds", type=int, default=16)
     p.add_argument("--fed-rounds", type=int, default=10)
-    p.add_argument("--dp-rounds", type=int, default=16)
+    p.add_argument("--dp-rounds", type=int, default=32)
     p.add_argument("--adressa-rounds", type=int, default=10)
     p.add_argument("--finetune-rounds", type=int, default=12)
     p.add_argument("--bf16-rounds", type=int, default=8)

@@ -14,8 +14,9 @@ batch size:
 so the artifact SAYS whether the 0.11–0.23 MFU window is a memory-bound
 ceiling (bandwidth fraction high) or unclaimed headroom (both fractions
 low → dispatch/latency/fusion problem). Assumptions of the bytes model are
-recorded in the artifact: token states read twice (fwd + bwd recompute),
-activations touched twice, params+opt-state read+written once per step.
+recorded in the artifact: the timed program is grad-only (no optimizer
+update, so no param/moment traffic), token states read twice (fwd + bwd
+recompute), activations touched twice.
 
 Run on TPU:  python benchmarks/step_profile.py
 """
@@ -87,11 +88,6 @@ def main() -> int:
     )
     text_p = variables["params"]["text_head"]
     user_p = variables["params"]["user_encoder"]
-    n_params = sum(
-        int(np.prod(x.shape))
-        for x in jax.tree_util.tree_leaves((text_p, user_p))
-    )
-
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
     peaks = next((v for f, v in _PEAKS.items() if f in kind), None)
 
@@ -104,13 +100,15 @@ def main() -> int:
         return _flops_per_train_step(cfg, B, num_news)
 
     def bytes_of(B: int, U: int) -> float:
-        """HBM traffic model for the full fwd+bwd step (assumptions in the
-        module docstring; recorded in the artifact)."""
+        """HBM traffic model for the TIMED program — full_fwd_bwd, a
+        grad-only step with NO optimizer update, so no params/Adam-moment
+        traffic is charged (assumptions in the module docstring; recorded
+        in the artifact). Param/grad reads are negligible next to the
+        token-state traffic (~100 KB vs hundreds of MB)."""
         token_reads = 2 * U * L * Dh * dt_bytes          # fwd + bwd recompute
         text_acts = 2 * U * (L * att_hidden_bytes() + D * dt_bytes)
         user_acts = 2 * B * (C + H) * D * dt_bytes * 3   # vecs, attn ctx, pool
-        opt = n_params * 4 * 2 * 3                       # p, m, v read+write f32
-        return token_reads + text_acts + user_acts + opt
+        return token_reads + text_acts + user_acts
 
     def att_hidden_bytes() -> int:
         return (Dh // 2) * dt_bytes
@@ -256,10 +254,11 @@ def main() -> int:
             "dtype": cfg.model.dtype,
             "batches": out_all,
             "bytes_model_assumptions": (
-                "token states read 2x (fwd + bwd recompute); text/user "
-                "activations touched 2x; params + Adam moments read+written "
-                "in f32; weight reads ignored (resident); gather index "
-                "traffic ignored"
+                "timed program is grad-only (no optimizer update, so no "
+                "param/Adam-moment traffic); token states read 2x (fwd + "
+                "bwd recompute); text/user activations touched 2x; weight/"
+                "grad reads ignored (~100 KB vs hundreds of MB); gather "
+                "index traffic ignored"
             ),
             "provenance": provenance(),
         }, indent=2)

@@ -119,6 +119,12 @@ class OptimConfig:
     news_lr: float = 5e-5
     optimizer: str = "adam"
     grad_clip_norm: float = 0.0        # 0 = off (DP clipping is separate)
+    # "constant" | "cosine" (optax.cosine_decay_schedule over decay_steps
+    # optimizer updates, floored at lr * lr_min_frac). Set decay_steps =
+    # rounds * local_epochs * steps_per_epoch; 0 disables the schedule.
+    lr_schedule: str = "constant"
+    decay_steps: int = 0
+    lr_min_frac: float = 0.1
 
 
 @dataclass

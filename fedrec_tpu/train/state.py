@@ -42,13 +42,29 @@ class ClientState:
 
 def make_optimizers(cfg: ExperimentConfig) -> tuple[optax.GradientTransformation, optax.GradientTransformation]:
     def _make(lr: float) -> optax.GradientTransformation:
+        if cfg.optim.lr_schedule not in ("constant", "cosine"):
+            raise ValueError(
+                f"unknown lr_schedule {cfg.optim.lr_schedule!r} "
+                "(constant|cosine)"
+            )
+        sched: float | optax.Schedule = lr
+        if cfg.optim.lr_schedule == "cosine" and cfg.optim.decay_steps > 0:
+            # cosine decay over the run's optimizer-step budget (the caller
+            # sets decay_steps = rounds * local_epochs * steps_per_epoch;
+            # decay_steps=0 means constant, per the config contract).
+            # Matters most for DP-SGD: injected-noise variance scales with
+            # lr^2, so a small late lr averages the noise out while the
+            # large early lr does the escaping (docs/DP.md)
+            sched = optax.cosine_decay_schedule(
+                lr, cfg.optim.decay_steps, alpha=cfg.optim.lr_min_frac
+            )
         txs = []
         if cfg.optim.grad_clip_norm > 0:
             txs.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))
         if cfg.optim.optimizer == "adam":
-            txs.append(optax.adam(lr))
+            txs.append(optax.adam(sched))
         elif cfg.optim.optimizer == "sgd":
-            txs.append(optax.sgd(lr))
+            txs.append(optax.sgd(sched))
         else:
             raise ValueError(f"unknown optimizer {cfg.optim.optimizer!r}")
         return optax.chain(*txs)

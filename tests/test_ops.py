@@ -232,3 +232,38 @@ def test_mha_module_chunked_routing(rng):
     out_d = dense.apply(params, x, x, x, mask)
     out_c = chunked.apply(params, x, x, x, mask)
     np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_d), atol=2e-5)
+
+
+def test_flash_attention_bf16_parity(rng):
+    """The streamed kernels run their dots in the INPUT dtype (bf16 = the
+    TPU model dtype, 4x MXU rate); pin that bf16 outputs track the f32
+    dense reference within bf16 resolution — a dtype-handling regression
+    (e.g. an accidental f32 upcast removed, or accumulation in bf16)
+    would blow this tolerance."""
+    from fedrec_tpu.ops.attention_kernels import _attention_dense, flash_attention
+
+    B, L, h, dk = 2, 40, 4, 20
+    q32, k32, v32 = (
+        rng.standard_normal((B, L, h, dk)).astype(np.float32) for _ in range(3)
+    )
+    mask = jnp.asarray((rng.random((B, L)) > 0.2).astype(np.float32))
+
+    def flat(x):
+        return (
+            jnp.asarray(x, jnp.float32).transpose(0, 2, 1, 3).reshape(B * h, L, dk)
+        )
+
+    bias = jnp.repeat(jnp.where(mask > 0, 0.0, -1e9), h, axis=0)
+    want = _attention_dense(flat(q32), flat(k32), flat(v32), bias)
+    want = np.asarray(want.reshape(B, h, L, dk).transpose(0, 2, 1, 3))
+
+    got = flash_attention(
+        jnp.asarray(q32, jnp.bfloat16),
+        jnp.asarray(k32, jnp.bfloat16),
+        jnp.asarray(v32, jnp.bfloat16),
+        mask,
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, atol=0.05
+    )
