@@ -102,15 +102,21 @@ def main() -> int:
     if len(jax.devices()) > 1:
         # mesh-sharded scorer (serve.build_recommend_fn_sharded): catalog +
         # score matrix split over every device, local top-k + gather merge.
-        # On the 8-fake-device CPU mesh (1 physical core) this proves the
-        # sharded program executes at scale — wall time there measures the
-        # core, not the sharding; the mesh win is a multi-chip property.
+        # CPU caveat: see the note written into the artifact below.
         from fedrec_tpu.parallel import client_mesh
         from fedrec_tpu.serve import build_recommend_fn_sharded
 
         mesh = client_mesh(len(jax.devices()))
         sfn = build_recommend_fn_sharded(model, mesh, top_k=args.top_k)
         sharded_rows = {"n_devices": mesh.size, "batches": {}}
+        if on_cpu:
+            sharded_rows["note"] = (
+                f"{mesh.size} FAKE devices on 1 physical core: this row "
+                "proves the sharded program executes at catalog scale; "
+                f"wall time measures the core running {mesh.size} device "
+                "programs serially + collective overhead, NOT the sharding "
+                "win, which is a multi-chip property"
+            )
         for B in (256, 1024):
             history = jnp.asarray(rng.integers(1, N, (B, H)).astype(np.int32))
             if on_cpu:
