@@ -221,3 +221,35 @@ def test_recommend_sharded_valid_mask_and_sentinels(setup):
     ids, scores = np.asarray(ids), np.asarray(scores)
     assert set(ids[0][ids[0] >= 0]) == {4, 5}
     assert np.all(scores[0][2:] <= np.finfo(np.float32).min)
+
+
+def test_recommend_sharded_with_gru_tower():
+    """The sharded scorer is user-tower-family-agnostic: GRU-tower params
+    drive it to the same ids/scores as the dense scorer."""
+    from fedrec_tpu.parallel import client_mesh
+    from fedrec_tpu.serve import build_recommend_fn_sharded
+
+    cfg = ExperimentConfig()
+    cfg.model.bert_hidden = 32
+    cfg.model.news_dim = 32
+    cfg.model.query_dim = 16
+    cfg.model.user_tower = "gru"
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(5)
+    n, d, b, h = 100, cfg.model.news_dim, 4, 10
+    news_vecs = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    history = jnp.asarray(rng.integers(1, n, (b, h)).astype(np.int32))
+    his_vecs = news_vecs[history]
+    params = model.init(
+        jax.random.PRNGKey(0), his_vecs, his_vecs,
+        method=NewsRecommender.__call__,
+    )["params"]["user_encoder"]
+
+    mesh = client_mesh(8)
+    dense = build_recommend_fn(model, top_k=6)
+    sharded = build_recommend_fn_sharded(model, mesh, top_k=6)
+    ids_d, s_d = jax.tree_util.tree_map(np.asarray, dense(params, news_vecs, history))
+    ids_s, s_s = jax.tree_util.tree_map(np.asarray, sharded(params, news_vecs, history))
+    np.testing.assert_allclose(s_s, s_d, rtol=1e-5, atol=1e-6)
+    for i in range(b):
+        assert set(ids_s[i]) == set(ids_d[i])
