@@ -136,9 +136,10 @@ def test_recommend_valid_mask(setup):
     assert np.all((ids < 50) & (ids > 0))
 
 
-def test_recommend_with_gru_tower():
-    """Serving is user-tower-family-agnostic: the GRU tower's params drive
-    the same jitted top-k path."""
+@pytest.fixture(scope="module")
+def gru_setup():
+    """GRU-tower serving fixture shared by the dense and sharded parity
+    tests (one init, one source of truth for the family's config)."""
     cfg = ExperimentConfig()
     cfg.model.bert_hidden = 32
     cfg.model.news_dim = 32
@@ -154,6 +155,13 @@ def test_recommend_with_gru_tower():
         jax.random.PRNGKey(0), his_vecs, his_vecs,
         method=NewsRecommender.__call__,
     )["params"]["user_encoder"]
+    return model, params, news_vecs, history, his_vecs, (b, h)
+
+
+def test_recommend_with_gru_tower(gru_setup):
+    """Serving is user-tower-family-agnostic: the GRU tower's params drive
+    the same jitted top-k path."""
+    model, params, news_vecs, history, his_vecs, (b, h) = gru_setup
     fn = build_recommend_fn(model, top_k=5)
     ids, scores = jax.tree_util.tree_map(np.asarray, fn(params, news_vecs, history))
     assert ids.shape == (b, 5) and np.isfinite(scores).all()
@@ -223,28 +231,13 @@ def test_recommend_sharded_valid_mask_and_sentinels(setup):
     assert np.all(scores[0][2:] <= np.finfo(np.float32).min)
 
 
-def test_recommend_sharded_with_gru_tower():
+def test_recommend_sharded_with_gru_tower(gru_setup):
     """The sharded scorer is user-tower-family-agnostic: GRU-tower params
     drive it to the same ids/scores as the dense scorer."""
     from fedrec_tpu.parallel import client_mesh
     from fedrec_tpu.serve import build_recommend_fn_sharded
 
-    cfg = ExperimentConfig()
-    cfg.model.bert_hidden = 32
-    cfg.model.news_dim = 32
-    cfg.model.query_dim = 16
-    cfg.model.user_tower = "gru"
-    model = NewsRecommender(cfg.model)
-    rng = np.random.default_rng(5)
-    n, d, b, h = 100, cfg.model.news_dim, 4, 10
-    news_vecs = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
-    history = jnp.asarray(rng.integers(1, n, (b, h)).astype(np.int32))
-    his_vecs = news_vecs[history]
-    params = model.init(
-        jax.random.PRNGKey(0), his_vecs, his_vecs,
-        method=NewsRecommender.__call__,
-    )["params"]["user_encoder"]
-
+    model, params, news_vecs, history, his_vecs, (b, h) = gru_setup
     mesh = client_mesh(8)
     dense = build_recommend_fn(model, top_k=6)
     sharded = build_recommend_fn_sharded(model, mesh, top_k=6)
