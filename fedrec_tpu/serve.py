@@ -33,6 +33,18 @@ from fedrec_tpu.models import NewsRecommender
 _NEG = jnp.finfo(jnp.float32).min
 
 
+def _exclude_ids(invalid: jnp.ndarray, ids: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Mark ``ids`` (B, H) invalid in the (B, n) mask via boolean
+    scatter-max; ids outside ``[0, n)`` are no-ops. Shared by the dense
+    and sharded scorers so their degenerate-input semantics cannot drift
+    apart — JAX's default scatter mode (promise_in_bounds) would WRAP a
+    negative id and exclude real item ``n-|id|``."""
+    rows = jnp.arange(ids.shape[0])[:, None]
+    in_range = (ids >= 0) & (ids < n)
+    safe = jnp.clip(ids, 0, n - 1)
+    return invalid.at[rows, safe].max(in_range)
+
+
 def build_recommend_fn(
     model: NewsRecommender,
     top_k: int = 10,
@@ -42,7 +54,11 @@ def build_recommend_fn(
     """Compile ``recommend(user_params, news_vecs, history) -> (ids, scores)``.
 
     ``history``: (B, H) int32 clicked-news ids, 0-padded like training
-    batches. Returns ``ids`` (B, k) int32 and ``scores`` (B, k) float32,
+    batches; ids outside ``[0, N)`` are ignored by the EXCLUSION mask
+    (identically in the dense and sharded scorers) — but the history
+    GATHER that feeds the user encoding still clamps/wraps them per JAX
+    indexing, so garbage ids perturb the user vector. Returns ``ids``
+    (B, k) int32 and ``scores`` (B, k) float32,
     best first, with ``k = min(top_k, N)``. When fewer than ``k`` valid
     items exist (tiny catalog, long history), the tail slots carry id ``-1``
     and the float32-min sentinel score — callers truncate at the first -1.
@@ -71,8 +87,7 @@ def build_recommend_fn(
         if valid_mask is not None:
             invalid = invalid | ~valid_mask[None, :]
         if exclude_history:
-            rows = jnp.arange(history.shape[0])[:, None]
-            invalid = invalid.at[rows, history].set(True)
+            invalid = _exclude_ids(invalid, history, n)
         scores = jnp.where(invalid, _NEG, scores)
         top_scores, top_ids = lax.top_k(scores, min(top_k, n))
         top_ids = jnp.where(top_scores <= _NEG, -1, top_ids)
@@ -148,14 +163,9 @@ def build_recommend_fn_sharded(
                 (hist.shape[0], n_local),
             )
             if exclude_history:
-                rows = jnp.arange(hist.shape[0])[:, None]
-                local = hist - base  # (B, H) in shard-local coordinates
-                in_shard = (local >= 0) & (local < n_local)
-                safe = jnp.clip(local, 0, n_local - 1)
-                # boolean scatter-max: marks only true in-shard hits; an
-                # out-of-shard id clips onto row `safe` with value False,
-                # which .max() leaves untouched
-                invalid = invalid.at[rows, safe].max(in_shard)
+                # shard-local coordinates: out-of-shard ids fall outside
+                # [0, n_local) and are no-ops
+                invalid = _exclude_ids(invalid, hist - base, n_local)
             scores = jnp.where(invalid, _NEG, scores)
             s_loc, i_loc = lax.top_k(scores, k_local)
             g_loc = base + i_loc

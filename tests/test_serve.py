@@ -203,6 +203,52 @@ def test_recommend_sharded_matches_dense(setup):
             assert set(ids_s[b]) == set(ids_d[b])
 
 
+def test_out_of_range_history_ids_ignored_in_both_paths(setup):
+    """History ids outside [0, N) are no-ops in BOTH scorers (ADVICE r4):
+    in particular a NEGATIVE id must not wrap (JAX's promise_in_bounds
+    scatter wraps negatives, excluding real item n-|id| in the old dense
+    path while the sharded path ignores it), and the two paths must agree
+    exactly on the degenerate input. The ``control`` run clips the
+    degenerate ids in-range and shows they ARE excludable then."""
+    from fedrec_tpu.parallel import client_mesh
+    from fedrec_tpu.serve import build_recommend_fn_sharded
+
+    cfg, model, params, news_vecs, history = setup
+    n = news_vecs.shape[0]
+    vecs = news_vecs
+    weird = np.asarray(history).copy()
+    # keep the probe items out of the genuine history slots
+    weird[weird == n - 1] = 5
+    weird[weird == n - 3] = 6
+    weird[:, 0] = n + 7          # beyond the catalog
+    weird[:, 1] = -3             # negative: wraps to n-3 under raw scatter
+    weird[:, 2] = 2 * n          # beyond even the padded sharded table
+    control = jnp.asarray(np.clip(weird, 0, n - 1))
+    weird = jnp.asarray(weird)
+
+    # top_k = n: every NON-EXCLUDED item appears in the result, so
+    # membership of n-1 reads the exclusion mask directly, independent of
+    # score magnitudes
+    dense = build_recommend_fn(model, top_k=n)
+    sharded = build_recommend_fn_sharded(model, client_mesh(8), top_k=n)
+    ids_w, s_w = map(np.asarray, dense(params, vecs, weird))
+    ids_s, s_s = map(np.asarray, sharded(params, vecs, weird))
+    np.testing.assert_allclose(s_s, s_w, rtol=1e-5, atol=1e-6)
+    for b in range(ids_w.shape[0]):
+        assert set(ids_s[b]) == set(ids_w[b])
+        # out-of-range ids are no-ops: n-1 stays recommendable, and the
+        # negative id did NOT wrap onto n-3
+        assert n - 1 in ids_w[b]
+        assert n - 3 in ids_w[b]
+    ids_c, _ = map(np.asarray, dense(params, vecs, control))
+    ids_cs, _ = map(np.asarray, sharded(params, vecs, control))
+    for b in range(ids_c.shape[0]):
+        # clipped in-range, the same slots ARE excluded — identically in
+        # the sharded path (control clips -3 -> 0, n+7/2n -> n-1)
+        assert n - 1 not in ids_c[b]
+        assert n - 1 not in ids_cs[b]
+
+
 def test_recommend_sharded_valid_mask_and_sentinels(setup):
     """valid_mask shards correctly, and a catalog with fewer recommendable
     items than top_k yields -1/sentinel tails just like the dense path."""
