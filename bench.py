@@ -499,6 +499,12 @@ def main() -> None:
             cached["cache_is_current_tree"] = (
                 bool(mc) and head[:7] == mc[0][:7] and dirty is False
             )
+        out["cpu_fallback_note"] = (
+            "XLA:CPU on this 1-core host, NOT the framework's target: the "
+            "vs_baseline ratio here compares JAX-CPU against the torch-CPU "
+            "baseline on the same starved host and says nothing about TPU "
+            "performance — quote the real-chip rows above, never this one"
+        )
         cached["cpu_fallback_now"] = out
         print(json.dumps(cached))
         return
@@ -721,6 +727,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] decoupled bonus metric failed: {e}\n")
 
+    if not on_tpu:
+        # no cached chip artifact existed, so this CPU run IS the primary
+        # output — it needs the same health warning the nested fallback gets
+        out["cpu_fallback_note"] = (
+            "XLA:CPU on this 1-core host, NOT the framework's target: the "
+            "vs_baseline ratio compares JAX-CPU against the torch-CPU "
+            "baseline on the same starved host and says nothing about TPU "
+            "performance"
+        )
     print(json.dumps(out))
 
 

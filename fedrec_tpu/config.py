@@ -179,6 +179,13 @@ class PrivacyConfig:
     # "dpsgd"  — per-example clip + noise on all trainable grads (correct)
     # "ldp_news" — reference parity: noise only on news-embedding grads, no clipping
     mechanism: str = "dpsgd"
+    # what DP rounds train (and therefore clip + noise):
+    # "all"  — user tower + text head (P ~ 25.5k on the harness model)
+    # "user" — user tower only, text head frozen at its current params;
+    #          shrinks the noised dimension (noise norm ~ sigma*C*sqrt(P)/B,
+    #          docs/DP.md section 2) and keeps the news representation
+    #          stationary under noise. dpsgd mechanism only.
+    dp_scope: str = "all"
 
 
 @dataclass

@@ -437,6 +437,21 @@ def _build_local_step(
             "mechanism='dpsgd' requires mode='joint'; use mechanism='ldp_news' "
             "(reference-parity noise, no rigorous epsilon) for decoupled mode"
         )
+    if cfg.privacy.enabled and cfg.privacy.dp_scope not in ("all", "user"):
+        raise ValueError(
+            f"unknown privacy.dp_scope {cfg.privacy.dp_scope!r}; "
+            "expected 'all' or 'user'"
+        )
+    # dp_scope='user': DP rounds train ONLY the user tower; the text head is
+    # frozen at its current params, so its grads are never computed, clipped,
+    # or noised — the per-example sensitivity bound C applies to the user
+    # grads alone and the noised dimension shrinks accordingly (docs/DP.md)
+    dp_user_only = use_dpsgd and cfg.privacy.dp_scope == "user"
+    if cfg.privacy.enabled and cfg.privacy.dp_scope == "user" and not use_dpsgd:
+        raise ValueError(
+            "privacy.dp_scope='user' requires mechanism='dpsgd' — ldp_news "
+            "noises only the news grads, which contradicts a user-only scope"
+        )
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
@@ -477,12 +492,26 @@ def _build_local_step(
 
                 b = batch["labels"].shape[0]
                 ex_rngs = jax.random.split(dropout_rng, b)
-                loss, (user_g, news_g) = per_example_clipped_grads(
-                    per_example_loss,
-                    (state.user_params, state.news_params),
-                    (batch["candidates"], batch["history"], batch["labels"], ex_rngs),
-                    cfg.privacy.clip_norm,
+                batch_args = (
+                    batch["candidates"], batch["history"], batch["labels"], ex_rngs,
                 )
+                if dp_user_only:
+                    loss, user_g = per_example_clipped_grads(
+                        lambda up, c, h, l, r: per_example_loss(
+                            (up, state.news_params), c, h, l, r
+                        ),
+                        state.user_params,
+                        batch_args,
+                        cfg.privacy.clip_norm,
+                    )
+                    news_g = None  # head frozen: no grad exists to leak
+                else:
+                    loss, (user_g, news_g) = per_example_clipped_grads(
+                        per_example_loss,
+                        (state.user_params, state.news_params),
+                        batch_args,
+                        cfg.privacy.clip_norm,
+                    )
             else:
 
                 def loss_fn(user_params, news_params):
@@ -543,19 +572,28 @@ def _build_local_step(
                         lambda g: lax.psum(g, seq_ax), news_g
                     )
             if noise_fn is not None:
-                user_g, news_g = noise_fn((user_g, news_g), noise_rng)
+                if news_g is None:
+                    (user_g,) = noise_fn((user_g,), noise_rng)
+                else:
+                    user_g, news_g = noise_fn((user_g, news_g), noise_rng)
             user_g = strategy.sync_grads(user_g, sync_axes)
-            news_g = strategy.sync_grads(news_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
-            n_updates, opt_news = opt_news_tx.update(news_g, state.opt_news, state.news_params)
+            if news_g is None:
+                new_news_params, opt_news = state.news_params, state.opt_news
+            else:
+                news_g = strategy.sync_grads(news_g, sync_axes)
+                n_updates, opt_news = opt_news_tx.update(
+                    news_g, state.opt_news, state.news_params
+                )
+                new_news_params = jax.tree_util.tree_map(
+                    lambda p, u: p + u, state.news_params, n_updates
+                )
             new_state = state.replace(
                 step=state.step + 1,
                 user_params=jax.tree_util.tree_map(
                     lambda p, u: p + u, state.user_params, u_updates
                 ),
-                news_params=jax.tree_util.tree_map(
-                    lambda p, u: p + u, state.news_params, n_updates
-                ),
+                news_params=new_news_params,
                 opt_user=opt_user,
                 opt_news=opt_news,
                 rng=rng,

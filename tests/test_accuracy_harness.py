@@ -33,11 +33,15 @@ def test_leg_fed_lr_routing_semantics():
 
     cfgs = {name: ar.fed_row_cfg(name, rounds=16) for name in ar.FED_ROWS}
 
-    assert cfgs["param_avg_8_fedavgm"].fed.server_opt == "sgd"
-    fedavgm_lr = cfgs["param_avg_8_fedavgm"].optim.user_lr
-    assert fedavgm_lr < 1e-2, (
-        "the fedavgm row must run conservative locals — server momentum "
-        "over lr-1e-2 round deltas over-accelerates (measured collapse)"
+    fa = cfgs["param_avg_8_fedavgm"]
+    assert fa.fed.server_opt == "sgd"
+    assert fa.fed.server_momentum == pytest.approx(0.5), (
+        "the fedavgm row runs momentum 0.5 at the shared lr — the best "
+        "point of the r5 (server_lr x momentum x local lr) sweep; m=0.9 "
+        "collapses at lr 1e-2 and needs crippled 5e-4 locals"
+    )
+    assert fa.optim.user_lr == pytest.approx(1e-2), (
+        "fedavgm trains at the SHARED sweep-optimum local lr since r5"
     )
     assert cfgs["local_1client"].optim.user_lr == pytest.approx(2e-3), (
         "local_1client takes 8x the steps/round of the federated rows; "
@@ -68,6 +72,45 @@ def test_leg_fed_32_client_step_equalization():
     )
 
 
+def test_leg_dp_row_routing_semantics():
+    """dp_row_cfg routes the round-5 levers correctly: scope, batch and
+    the sigma calibration per row — asserted on returned configs."""
+    import accuracy_run as ar
+
+    n_train = 8000
+    cfgs = {n: ar.dp_row_cfg(n, rounds=32, n_train=n_train) for n in ar.DP_ROWS}
+
+    assert not cfgs["nodp_tuned"].privacy.enabled
+    for name in ("dp_eps50", "dp_eps10", "dp_eps3"):
+        c = cfgs[name]
+        assert c.privacy.enabled and c.privacy.dp_scope == "all"
+        assert c.privacy.sigma > 0 and c.privacy.clip_norm == 1.0
+        assert c.data.batch_size == 64
+    assert cfgs["dp_eps10_user"].privacy.dp_scope == "user"
+    assert cfgs["dp_eps10_user"].privacy.sigma == pytest.approx(
+        cfgs["dp_eps10"].privacy.sigma
+    ), "scope must not change the calibration (same mechanism, q, steps)"
+    froz = cfgs["nodp_user_frozen"].privacy
+    assert froz.enabled and froz.dp_scope == "user"
+    assert froz.sigma <= 1e-10 and froz.clip_norm >= 1e5, (
+        "the ceiling row must be the sigma->0 / inactive-clip limit, i.e. "
+        "non-private user-only training"
+    )
+    # tighter privacy -> larger sigma at the same step budget
+    assert (
+        cfgs["dp_eps3"].privacy.sigma
+        > cfgs["dp_eps10"].privacy.sigma
+        > cfgs["dp_eps50"].privacy.sigma
+    )
+    # batch rows (if present) recalibrate sigma for their own q
+    for name, spec in ar.DP_ROWS.items():
+        b = spec.get("batch", 64)
+        assert cfgs[name].data.batch_size == b
+        if spec.get("eps") is not None:
+            steps = max((n_train // 8) // b, 1) * 32 * 2
+            assert cfgs[name].optim.decay_steps == steps
+
+
 @pytest.mark.slow
 def test_leg_dp_one_round_writes_schema(tmp_path):
     """One-round dp leg end-to-end in a subprocess: the artifact lands
@@ -89,15 +132,23 @@ def test_leg_dp_one_round_writes_schema(tmp_path):
             env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
         )
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        import accuracy_run as ar
+
         d = json.loads(art.read_text())
-        assert set(d["runs"]) == {"nodp_tuned", "dp_eps50", "dp_eps10", "dp_eps3"}
+        assert set(d["runs"]) == set(ar.DP_ROWS)
         assert d["recipe"]["lr_schedule"] == "cosine"
         assert d["recipe"]["clip_norm"] == 1.0
-        # every dp row calibrated a sigma and recorded its epsilon
+        eps_rows = {
+            n for n, spec in ar.DP_ROWS.items() if spec.get("eps") is not None
+        }
+        # every dp row calibrated a sigma and recorded its epsilon + scope
         for name, run in d["runs"].items():
-            if name != "nodp_tuned":
+            if name in eps_rows:
                 assert run["sigma"] > 0 and run["epsilon"] > 0
-        assert set(d["gap_to_anchor"]) == {"dp_eps50", "dp_eps10", "dp_eps3"}
+            assert run["dp_scope"] in ("all", "user")
+            assert run["batch_size"] >= 1
+        assert set(d["gap_to_anchor"]) == eps_rows
+        assert d["user_frozen_ceiling_auc"] > 0
     finally:
         if backup is not None:
             art.write_bytes(backup)
