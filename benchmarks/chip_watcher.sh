@@ -51,6 +51,9 @@ verify_acc_bf16() {
 verify_serve() {
   verify_json_artifact benchmarks/serve_bench.json serve
 }
+verify_acc_dp() { # tuned anchor + eps=10 DP row proven on-chip (r4 #7)
+  verify_json_artifact benchmarks/accuracy_dp_tpu.json acc_dp
+}
 
 run_item() { # name timeout cmd...
   local name=$1 tmo=$2; shift 2
@@ -69,7 +72,7 @@ run_item() { # name timeout cmd...
 
 while :; do
   remaining=0
-  for n in bench pallas step_profile acc_bf16 serve; do
+  for n in bench pallas step_profile acc_bf16 serve acc_dp; do
     [ -e "$MARK/$n" ] || remaining=$((remaining + 1))
   done
   if [ "$remaining" -eq 0 ]; then
@@ -83,6 +86,8 @@ while :; do
     run_item step_profile 1800 python benchmarks/step_profile.py
     run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
     run_item serve 1800 python benchmarks/serve_bench.py
+    run_item acc_dp 3600 env FEDREC_DP_ROWS=nodp_tuned,dp_eps10 \
+      python benchmarks/accuracy_run.py --leg dp --dp-rounds 32
   else
     echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"
   fi

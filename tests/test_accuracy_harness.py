@@ -111,6 +111,59 @@ def test_leg_dp_row_routing_semantics():
             assert cfgs[name].optim.decay_steps == steps
 
 
+def test_leg_dp_row_filter_and_artifact_routing(monkeypatch, tmp_path):
+    """FEDREC_DP_ROWS runs only the named rows (the chip queue's on-TPU
+    proof is anchor+eps10, not the 7-row sweep), and the artifact routes
+    to accuracy_dp_tpu.json off-CPU so the chip run can never clobber the
+    CPU full-sweep artifact. _train is stubbed: this tests wiring."""
+    import accuracy_run as ar
+
+    calls = []
+
+    def fake_train(cfg, data, states, on_round=None):
+        calls.append(cfg)
+        return {"curve": [{"auc": 0.6, "mrr": 0.3, "ndcg5": 0.3,
+                           "ndcg10": 0.4, "round": 0, "train_loss": 1.0}]}
+
+    class _FakeData:
+        train_samples = list(range(800))
+        valid_samples = list(range(100))
+        num_news = 64
+
+    monkeypatch.setattr(ar, "_train", fake_train)
+    monkeypatch.setattr(ar, "HERE", tmp_path)
+    monkeypatch.setattr(ar, "oracle_auc", lambda d, s: 0.77)
+    monkeypatch.setattr(ar, "_small_corpus", lambda: (_FakeData(), None))
+    monkeypatch.setenv("FEDREC_DP_ROWS", "nodp_tuned,dp_eps10")
+    ar.leg_dp(rounds=1)
+    assert len(calls) == 2
+    # ANY subset — even a wedge CPU-fallback of the chip queue item —
+    # writes the sidecar name, never the canonical full-sweep artifact
+    art = json.loads((tmp_path / "accuracy_dp_tpu.json").read_text())
+    assert set(art["runs"]) == {"nodp_tuned", "dp_eps10"}
+    assert set(art["gap_to_anchor"]) == {"dp_eps10"}
+    assert "user_frozen_ceiling_auc" not in art
+    assert not (tmp_path / "accuracy_dp.json").exists()
+    # a typo fails fast, before any training
+    calls.clear()
+    monkeypatch.setenv("FEDREC_DP_ROWS", "dp_eps_10")
+    with pytest.raises(SystemExit, match="unknown rows"):
+        ar.leg_dp(rounds=1)
+    assert not calls
+    # the anchor is auto-included when omitted
+    calls.clear()
+    monkeypatch.setenv("FEDREC_DP_ROWS", "dp_eps10")
+    ar.leg_dp(rounds=1)
+    assert len(calls) == 2
+    # the full sweep on cpu owns the canonical artifact name
+    calls.clear()
+    monkeypatch.delenv("FEDREC_DP_ROWS")
+    ar.leg_dp(rounds=1)
+    assert len(calls) == len(ar.DP_ROWS)
+    art = json.loads((tmp_path / "accuracy_dp.json").read_text())
+    assert set(art["runs"]) == set(ar.DP_ROWS)
+
+
 @pytest.mark.slow
 def test_leg_dp_one_round_writes_schema(tmp_path):
     """One-round dp leg end-to-end in a subprocess: the artifact lands
@@ -124,6 +177,7 @@ def test_leg_dp_one_round_writes_schema(tmp_path):
     backup = art.read_bytes() if art.exists() else None
     env = cpu_host_env(8)
     env["FEDREC_ACC_INNER"] = "1"
+    env.pop("FEDREC_DP_ROWS", None)  # ambient filter would break the sweep
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     try:
         proc = subprocess.run(
