@@ -98,40 +98,69 @@ def main() -> int:
         }
         print(f"B={B:5d}  {B/dt:12.1f} users/s  ({dt*1e3:.3f} ms)", flush=True)
 
-    sharded_rows = None
-    if len(jax.devices()) > 1:
-        # mesh-sharded scorer (serve.build_recommend_fn_sharded): catalog +
-        # score matrix split over every device, local top-k + gather merge.
-        # CPU caveat: see the note written into the artifact below.
-        from fedrec_tpu.parallel import client_mesh
-        from fedrec_tpu.serve import build_recommend_fn_sharded
+    # mesh-sharded scorer (serve.build_recommend_fn_sharded): catalog +
+    # score matrix split over every device, local top-k + gather merge.
+    # Runs even on ONE device (size-1 mesh): on the single-chip TPU rig
+    # that is the only available on-hardware execution proof for the
+    # sharded program — the WIN is a multi-chip property (see verdict).
+    from fedrec_tpu.parallel import client_mesh
+    from fedrec_tpu.serve import build_recommend_fn_sharded
 
-        mesh = client_mesh(len(jax.devices()))
-        sfn = build_recommend_fn_sharded(model, mesh, top_k=args.top_k)
-        sharded_rows = {"n_devices": mesh.size, "batches": {}}
+    mesh = client_mesh(len(jax.devices()))
+    sfn = build_recommend_fn_sharded(model, mesh, top_k=args.top_k)
+    sharded_rows = {"n_devices": mesh.size, "batches": {}}
+    if on_cpu and mesh.size > 1:
+        sharded_rows["note"] = (
+            f"{mesh.size} FAKE devices on 1 physical core: this row "
+            "proves the sharded program executes at catalog scale; "
+            f"wall time measures the core running {mesh.size} device "
+            "programs serially + collective overhead, NOT the sharding "
+            "win, which is a multi-chip property"
+        )
+    if mesh.size == 1:
+        sharded_rows["note"] = (
+            "size-1 mesh: proves the shard_map serving program (local "
+            "top-k + all_gather merge) executes on this hardware; its "
+            "throughput should track the dense rows"
+        )
+    for B in (256, 1024):
+        history = jnp.asarray(rng.integers(1, N, (B, H)).astype(np.int32))
         if on_cpu:
-            sharded_rows["note"] = (
-                f"{mesh.size} FAKE devices on 1 physical core: this row "
-                "proves the sharded program executes at catalog scale; "
-                f"wall time measures the core running {mesh.size} device "
-                "programs serially + collective overhead, NOT the sharding "
-                "win, which is a multi-chip property"
+            dt = cpu_best_of_3(sfn, user_params, table, history)
+        else:
+            dt = _time(
+                jax.jit(lambda t, h: sfn(user_params, t, h)[1]),
+                table, history,
             )
-        for B in (256, 1024):
-            history = jnp.asarray(rng.integers(1, N, (B, H)).astype(np.int32))
-            if on_cpu:
-                dt = cpu_best_of_3(sfn, user_params, table, history)
-            else:
-                dt = _time(
-                    jax.jit(lambda t, h: sfn(user_params, t, h)[1]),
-                    table, history,
-                )
-            sharded_rows["batches"][str(B)] = {
-                "users_per_sec": round(B / dt, 2),
-                "ms_per_batch": round(dt * 1e3, 3),
-            }
-            print(f"B={B:5d} sharded x{mesh.size}  {B/dt:10.1f} users/s",
-                  flush=True)
+        sharded_rows["batches"][str(B)] = {
+            "users_per_sec": round(B / dt, 2),
+            "ms_per_batch": round(dt * 1e3, 3),
+        }
+        print(f"B={B:5d} sharded x{mesh.size}  {B/dt:10.1f} users/s",
+              flush=True)
+
+    # when does sharded win? One (B, k) all_gather per query vs splitting
+    # the (N, D) table + (B, N) scores: computed from THIS run's geometry
+    # so the artifact carries its own one-line verdict (VERDICT r4 #6).
+    itemsize = jnp.dtype(cfg.model.dtype).itemsize
+    hbm_budget = 12e9  # ~16 GB chip, leave compiler/program headroom
+    bmax = 1024
+    n_single_chip = int(hbm_budget / (D * itemsize + bmax * 4))
+    side = (
+        f"this run's N={N:,} is below that cutoff, where dense on one "
+        "chip avoids the all_gather merge entirely and a "
+        f"size-{mesh.size} mesh adds capacity, not speed"
+        if N <= n_single_chip
+        else f"this run's N={N:,} EXCEEDS the cutoff: the sharded scorer "
+        "is the only single-program option at this catalog size"
+    )
+    verdict = (
+        f"sharded wins when the catalog stops fitting one device: at "
+        f"D={D}/{cfg.model.dtype}/B={bmax} one ~16 GB chip holds "
+        f"N ~= {n_single_chip:,} news (table + f32 scores); {side}"
+    )
+    sharded_rows["verdict"] = verdict
+    print(f"[serve] {verdict}", flush=True)
 
     from fedrec_tpu.utils.provenance import provenance
 
