@@ -86,7 +86,11 @@ while :; do
     run_item step_profile 1800 python benchmarks/step_profile.py
     run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
     run_item serve 1800 python benchmarks/serve_bench.py
-    run_item acc_dp 3600 env FEDREC_DP_ROWS=nodp_tuned,dp_eps10 \
+    # FEDREC_ACC_INNER=1: without it accuracy_run.py self-hardens by
+    # re-exec'ing under JAX_PLATFORMS=cpu and the on-chip proof could
+    # never bank (it would burn every window on a CPU run)
+    run_item acc_dp 3600 env FEDREC_ACC_INNER=1 \
+      FEDREC_DP_ROWS=nodp_tuned,dp_eps10 \
       python benchmarks/accuracy_run.py --leg dp --dp-rounds 32
   else
     echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"

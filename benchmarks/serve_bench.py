@@ -140,12 +140,14 @@ def main() -> int:
               flush=True)
 
     # when does sharded win? One (B, k) all_gather per query vs splitting
-    # the (N, D) table + (B, N) scores: computed from THIS run's geometry
-    # so the artifact carries its own one-line verdict (VERDICT r4 #6).
-    itemsize = jnp.dtype(cfg.model.dtype).itemsize
+    # the (N, D) table + (B, N) scores — a CHIP-sizing question, so the
+    # cutoff is computed for the chip serving dtype (bf16 table; the
+    # scorer always keeps scores f32) even when this run is the f32 CPU
+    # fallback. The artifact carries its own one-line verdict (r4 #6).
+    chip_itemsize = 2  # bfloat16 table on the chip path
     hbm_budget = 12e9  # ~16 GB chip, leave compiler/program headroom
     bmax = 1024
-    n_single_chip = int(hbm_budget / (D * itemsize + bmax * 4))
+    n_single_chip = int(hbm_budget / (D * chip_itemsize + bmax * 4))
     side = (
         f"this run's N={N:,} is below that cutoff, where dense on one "
         "chip avoids the all_gather merge entirely and a "
@@ -156,7 +158,7 @@ def main() -> int:
     )
     verdict = (
         f"sharded wins when the catalog stops fitting one device: at "
-        f"D={D}/{cfg.model.dtype}/B={bmax} one ~16 GB chip holds "
+        f"D={D}/bfloat16-table/B={bmax} one ~16 GB chip holds "
         f"N ~= {n_single_chip:,} news (table + f32 scores); {side}"
     )
     sharded_rows["verdict"] = verdict

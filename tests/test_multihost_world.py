@@ -105,6 +105,31 @@ WORLD_WORKER = textwrap.dedent(
         user=flat_u, news=flat_n, loss=np.asarray(loss),
     )
 
+    # mesh-sharded SERVING over the same 2-process global mesh: catalog
+    # split across BOTH processes' devices, local top-k + all_gather merge
+    from fedrec_tpu.serve import build_recommend_fn_sharded
+
+    rng = np.random.default_rng(5)
+    n_cat = 100  # not divisible by 8: padding path
+    catalog = jax.device_put(
+        rng.standard_normal((n_cat, 32)).astype(np.float32),
+        NamedSharding(mesh, P()),
+    )
+    hist_serve = jax.device_put(
+        rng.integers(1, n_cat, (6, 10)).astype(np.int32),
+        NamedSharding(mesh, P()),
+    )
+    u0 = jax.tree_util.tree_map(lambda x: x[0], rep[0])
+    serve_fn = build_recommend_fn_sharded(model, mesh, top_k=5)
+    ids_sv, scores_sv = serve_fn(u0, catalog, hist_serve)
+    rep_sv = jax.jit(
+        lambda t: t, out_shardings=NamedSharding(mesh, P())
+    )((ids_sv, scores_sv))
+    ids_sv, scores_sv = map(np.asarray, rep_sv)
+    assert ids_sv.shape == (6, 5)
+    assert np.isfinite(scores_sv[ids_sv >= 0]).all()
+    np.savez(outdir / f"serve_{pid}.npz", ids=ids_sv, scores=scores_sv)
+
     # one coordinator CONTROL round in the same world
     rt = CoordinatorRuntime(collective_timeout_s=120.0)
     assert rt.start_round(0, 1) == 0
@@ -189,6 +214,12 @@ def test_two_process_global_mesh_matches_single_process(tmp_path):
     np.testing.assert_array_equal(w0["user"], w1["user"])
     np.testing.assert_array_equal(w0["news"], w1["news"])
     np.testing.assert_array_equal(w0["loss"], w1["loss"])
+    # the sharded serving program ran over the same 2-process mesh and
+    # both processes saw one answer
+    s0 = np.load(tmp_path / "serve_0.npz")
+    s1 = np.load(tmp_path / "serve_1.npz")
+    np.testing.assert_array_equal(s0["ids"], s1["ids"])
+    np.testing.assert_array_equal(s0["scores"], s1["scores"])
     # and the world's math equals the single-process mesh at float tolerance
     np.testing.assert_allclose(w0["user"], gold_u, rtol=2e-4, atol=1e-6)
     np.testing.assert_allclose(w0["news"], gold_n, rtol=2e-4, atol=1e-6)
