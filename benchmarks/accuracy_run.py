@@ -358,6 +358,12 @@ FED_ROWS = {
     "param_avg_32_cohort": ("param_avg", 32, "head"),
     # second model family: recurrent (LSTUR-style) user tower
     "gru_tower_8": ("param_avg", 8, "head+gru"),
+    # third model family: CNN text head (NAML-style, Wu et al. 2019).
+    # Shared lr 1e-2 is also its own sweep optimum (5e-3 -> 0.759,
+    # 2e-2 diverges); it trails the additive head (~0.77 vs 0.80) on this
+    # corpus BY CONSTRUCTION — the synthetic token states carry no
+    # token-order signal for the conv window to read
+    "cnn_head_8": ("param_avg", 8, "head+cnn"),
 }
 
 
@@ -385,6 +391,9 @@ def fed_row_cfg(name: str, rounds: int):
     if mode.endswith("+gru"):
         mode = mode.split("+")[0]
         cfg.model.user_tower = "gru"
+    if mode.endswith("+cnn"):
+        mode = mode.split("+")[0]
+        cfg.model.text_head_arch = "cnn"
     cfg.model.text_encoder_mode = mode
     cfg.fed.strategy = strategy
     cfg.fed.num_clients = clients

@@ -70,6 +70,13 @@ class ModelConfig:
     #           combinable with fed.seq_shards>1 (sequence parallelism is
     #           attention-specific).
     user_tower: str = "mha"
+    # text-head family (the trainable tail over frozen trunk token states):
+    #   "additive" — additive attention + linear (reference encoder.py:20-29)
+    #   "cnn"      — Conv1D + ReLU + additive pooling (NAML family, Wu et
+    #                al. 2019). head/table modes only; finetune keeps the
+    #                additive head.
+    text_head_arch: str = "additive"
+    cnn_kernel: int = 3                # CNN head context window
     bert_hidden: int = 768             # DistilBERT hidden size
     # "table"    — gather a precomputed news-embedding table (fast path)
     # "head"     — frozen-trunk token states + trainable additive-attn/linear head

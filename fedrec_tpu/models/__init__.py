@@ -8,7 +8,12 @@ from fedrec_tpu.models.bert import (
     load_hf_state_dict,
     precompute_token_states,
 )
-from fedrec_tpu.models.encoders import GRUUserEncoder, TextHead, UserEncoder
+from fedrec_tpu.models.encoders import (
+    CnnTextHead,
+    GRUUserEncoder,
+    TextHead,
+    UserEncoder,
+)
 from fedrec_tpu.models.recommender import NewsRecommender, score_candidates, score_loss
 
 __all__ = [
@@ -19,6 +24,7 @@ __all__ = [
     "NewsRecommender",
     "TextEncoder",
     "TextHead",
+    "CnnTextHead",
     "GRUUserEncoder",
     "UserEncoder",
     "convert_hf_state_dict",

@@ -267,6 +267,11 @@ def trunk_config_from(model_cfg) -> DistilBertConfig:
 
 def make_text_encoder(model_cfg) -> "TextEncoder":
     """Full trainable text tower for ``text_encoder_mode='finetune'``."""
+    if getattr(model_cfg, "text_head_arch", "additive") != "additive":
+        raise NotImplementedError(
+            "text_encoder_mode='finetune' supports only the additive head; "
+            "use text_head_arch='cnn' with mode 'head' or 'table'"
+        )
     return TextEncoder(
         trunk_cfg=trunk_config_from(model_cfg),
         news_dim=model_cfg.news_dim,

@@ -48,6 +48,48 @@ class TextHead(nn.Module):
         return nn.Dense(self.news_dim, dtype=self.dtype, name="fc")(pooled)
 
 
+class CnnTextHead(nn.Module):
+    """CNN text head — the NAML model family (Wu et al. 2019, "Neural News
+    Recommendation with Attentive Multi-View Learning"): Conv1D over the
+    frozen trunk's token states -> ReLU -> additive-attention pooling.
+
+    A third architecture family beyond the reference's single additive
+    head (reference ``encoder.py:20-29``) and the GRU/LSTUR user tower.
+    TPU shape: a SAME-padded width-``kernel`` convolution lowers to one
+    ``(L, kernel*hidden) x (kernel*hidden, news_dim)`` matmul per news —
+    MXU-friendly, static shapes, no Python loops.
+
+    (..., L, bert_hidden) token states -> (..., news_dim) news vector.
+    """
+
+    news_dim: int = 400
+    bert_hidden: int = 768
+    kernel: int = 3
+    stable_softmax: bool = True
+    dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(
+        self, token_states: jnp.ndarray, mask: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        x = nn.Conv(
+            self.news_dim,
+            kernel_size=(self.kernel,),
+            padding="SAME",
+            dtype=self.dtype,
+            name="conv",
+        )(token_states.astype(self.dtype))
+        x = nn.relu(x)
+        return AdditiveAttention(
+            hidden=self.news_dim // 2,
+            stable_softmax=self.stable_softmax,
+            dtype=self.dtype,
+            use_pallas=self.use_pallas,
+            name="pool",
+        )(x, mask)
+
+
 class GRUUserEncoder(nn.Module):
     """Recurrent user tower (LSTUR family, An et al. 2019 "Neural News
     Recommendation with Long- and Short-term User Representations"):

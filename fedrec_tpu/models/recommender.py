@@ -19,7 +19,12 @@ import optax
 from flax import linen as nn
 
 from fedrec_tpu.config import ModelConfig
-from fedrec_tpu.models.encoders import GRUUserEncoder, TextHead, UserEncoder
+from fedrec_tpu.models.encoders import (
+    CnnTextHead,
+    GRUUserEncoder,
+    TextHead,
+    UserEncoder,
+)
 
 
 def score_candidates(cand_vecs: jnp.ndarray, user_vec: jnp.ndarray) -> jnp.ndarray:
@@ -72,13 +77,31 @@ class NewsRecommender(nn.Module):
 
     def setup(self):
         dtype = jnp.dtype(self.cfg.dtype)
-        self.text_head = TextHead(
-            news_dim=self.cfg.news_dim,
-            bert_hidden=self.cfg.bert_hidden,
-            stable_softmax=self.cfg.stable_softmax,
-            dtype=dtype,
-            use_pallas=self.cfg.use_pallas,
-        )
+        arch = getattr(self.cfg, "text_head_arch", "additive")
+        if arch == "cnn":
+            # attribute name (hence param-tree path "text_head") is shared
+            # across head families, like user_tower; leaves differ, so
+            # snapshots are per-family
+            self.text_head = CnnTextHead(
+                news_dim=self.cfg.news_dim,
+                bert_hidden=self.cfg.bert_hidden,
+                kernel=getattr(self.cfg, "cnn_kernel", 3),
+                stable_softmax=self.cfg.stable_softmax,
+                dtype=dtype,
+                use_pallas=self.cfg.use_pallas,
+            )
+        elif arch == "additive":
+            self.text_head = TextHead(
+                news_dim=self.cfg.news_dim,
+                bert_hidden=self.cfg.bert_hidden,
+                stable_softmax=self.cfg.stable_softmax,
+                dtype=dtype,
+                use_pallas=self.cfg.use_pallas,
+            )
+        else:
+            raise ValueError(
+                f"unknown model.text_head_arch {arch!r}; have 'additive', 'cnn'"
+            )
         tower = getattr(self.cfg, "user_tower", "mha")
         if tower == "gru":
             if self.seq_axis is not None:

@@ -311,7 +311,9 @@ class Trainer:
         ]
         saved_mode = saved.get("text_encoder_mode")
         if saved_mode != "table":
-            tree_knobs.append("bert_hidden")
+            # the text-head family + its conv width shape the text_head
+            # subtree exactly like user_tower shapes the user_encoder one
+            tree_knobs += ["bert_hidden", "text_head_arch", "cnn_kernel"]
         if saved_mode == "finetune":
             tree_knobs += [
                 "trunk_layers", "trunk_heads", "trunk_ffn", "trunk_vocab",

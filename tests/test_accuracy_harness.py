@@ -47,8 +47,10 @@ def test_leg_fed_lr_routing_semantics():
         "local_1client takes 8x the steps/round of the federated rows; "
         "its measured optimum is 2e-3"
     )
+    assert cfgs["cnn_head_8"].model.text_head_arch == "cnn"
+    assert cfgs["gru_tower_8"].model.user_tower == "gru"
     for name in ("param_avg_8", "grad_avg_8", "param_avg_32_cohort",
-                 "gru_tower_8"):
+                 "gru_tower_8", "cnn_head_8"):
         assert cfgs[name].fed.server_opt == "none"
         assert cfgs[name].optim.user_lr == pytest.approx(1e-2), (
             f"{name} must train at the shared sweep-optimum lr 1e-2 — a "

@@ -281,6 +281,32 @@ def test_resume_wrong_user_tower_fails_with_guided_error(tmp_path):
     assert saved["model"]["user_tower"] == "mha"
 
 
+def test_resume_wrong_text_head_arch_fails_with_guided_error(tmp_path):
+    """The text-head family (and its conv width) shape the text_head
+    subtree like user_tower shapes user_encoder — resuming a cnn-head
+    snapshot with the additive config (or another kernel width) must name
+    the knob, not surface a raw orbax tree error."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=1, train__save_every=1)
+    cfg.model.text_encoder_mode = "head"
+    cfg.model.text_head_arch = "cnn"
+    data, token_states = tiny_data(cfg)
+    Trainer(cfg, data, token_states).run()
+
+    cfg2 = tiny_cfg(tmp_path, fed__rounds=2, train__save_every=1)
+    cfg2.model.text_encoder_mode = "head"
+    with pytest.raises(ValueError, match="text_head_arch"):
+        Trainer(cfg2, data, token_states)
+
+    cfg3 = tiny_cfg(tmp_path, fed__rounds=2, train__save_every=1)
+    cfg3.model.text_encoder_mode = "head"
+    cfg3.model.text_head_arch = "cnn"
+    cfg3.model.cnn_kernel = 5
+    with pytest.raises(ValueError, match="cnn_kernel"):
+        Trainer(cfg3, data, token_states)
+
+
 WORKER = textwrap.dedent(
     """
     import os, sys
