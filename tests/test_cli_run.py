@@ -202,3 +202,39 @@ def test_run_cli_dp_epsilon(tmp_path):
     )
     assert "DP enabled: eps=10" in out and "sigma=" in out
     assert "final:" in out
+
+
+def test_recommend_cli_round_trip_cnn_head(tmp_path):
+    """Train -> serve with the CNN text-head family: the persisted config
+    must carry text_head_arch so serving rebuilds the SAME head to encode
+    the catalog — a snapshot from one family restored into another is the
+    exact failure the resume guard exists for, and the CLI must never hit
+    it silently."""
+    shard = "/root/reference/UserData"
+    if not os.path.isdir(shard):
+        pytest.skip("reference demo shard not present")
+    common = ["--set", "model.bert_hidden=32", "--set", "model.news_dim=32",
+              "--set", "model.num_heads=4", "--set", "model.head_dim=8",
+              "--set", "model.query_dim=16", "--set", "data.max_his_len=10",
+              "--set", "model.text_head_arch=cnn"]
+    _run_cli(["1", "2", "1", "--strategy", "param_avg", "--clients", "2",
+              "--data-dir", shard, *common], tmp_path)
+
+    env = cpu_host_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out_path = tmp_path / "recs.jsonl"
+    # NOTE: no --set overrides here — serving must pick the cnn arch up
+    # from the persisted training config on its own
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.recommend",
+         "--data-dir", shard, "--snapshot-dir", str(tmp_path / "snapshots"),
+         "--top-k", "5", "--out", str(out_path), "--allow-random-states"],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "using training config" in proc.stderr
+    lines = [json.loads(ln) for ln in out_path.read_text().splitlines()]
+    assert lines, "no recommendations written"
+    for rec in lines:
+        assert 0 < len(rec["news"]) <= 5
+        assert rec["scores"] == sorted(rec["scores"], reverse=True)

@@ -354,8 +354,6 @@ def test_dpsgd_user_scope_under_cohorts_and_scan():
     (b) dispatched per-batch vs inside the epoch-in-jit lax.scan. All four
     programs share _build_local_step, so divergence = a wiring bug in the
     cohort vmap or scan carry, not the mechanism."""
-    import copy
-
     from tests.test_scan import _collect_batches
     from tests.test_train import make_setup, small_cfg
     from fedrec_tpu.fed import get_strategy
