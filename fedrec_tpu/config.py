@@ -215,6 +215,12 @@ class TrainConfig:
     # (tests/test_scan.py). Chains compile for this one static length; a
     # short epoch tail falls back to per-batch dispatch.
     scan_steps: int = 1
+    # keep a separate best-validation-AUC snapshot under
+    # <snapshot_dir>/best (full snapshot dir incl. config.json, so
+    # `fedrec-recommend --snapshot-dir .../best` serves the best round
+    # directly); the incumbent best survives resume. Off by default: the
+    # round-cadence snapshots stay the only writers unless asked.
+    keep_best: bool = False
     log_every: int = 10
     seed: int = 42
     profile: bool = False              # jax.profiler trace around the hot loop

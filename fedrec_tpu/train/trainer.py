@@ -274,6 +274,35 @@ class Trainer:
             except OSError as e:
                 print(f"[trainer] could not persist config.json: {e}")
 
+        self.best_snapshots: SnapshotManager | None = None
+        self._best_auc: float | None = None
+        if self.snapshots is not None and cfg.train.keep_best:
+            import json as _json
+
+            best_dir = self.snapshots.directory / "best"
+            self.best_snapshots = SnapshotManager(best_dir, max_to_keep=1)
+            marker = best_dir / "best.json"
+            if marker.exists():
+                # resumed run: the incumbent best must never be replaced
+                # by a worse later round
+                try:
+                    m = _json.loads(marker.read_text())
+                    best_round, best_auc = int(m["round"]), float(m["auc"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    best_round = best_auc = None
+                stored = self.best_snapshots.latest_round()
+                if best_round is not None and stored == best_round:
+                    self._best_auc = best_auc
+                elif stored is not None or best_round is not None:
+                    # torn state (crash between the snapshot save and the
+                    # marker write): the stored snapshot's AUC is unknown,
+                    # so let the next improvement rewrite both coherently
+                    print(
+                        "[trainer] best-snapshot marker/round mismatch "
+                        f"(marker {best_round}, stored {stored}); best-AUC "
+                        "tracking restarts this run"
+                    )
+
         self.logger = MetricLogger(
             use_wandb=cfg.train.wandb,
             project=cfg.train.wandb_project,
@@ -787,6 +816,46 @@ class Trainer:
                     # log null
                     log.update({k: v for k, v in named.items() if v is not None})
                 self.logger.log(round_idx, log)
+                auc = (
+                    result.val_metrics.get("auc")
+                    if result.val_metrics else None
+                )
+                if (
+                    self.best_snapshots is not None
+                    and auc is not None
+                    and (self._best_auc is None or auc > self._best_auc)
+                ):
+                    import json as _json
+
+                    from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                    # a failed best-write must not kill training (the
+                    # round-cadence config.json persistence has the same
+                    # policy) and must not advance _best_auc — a later
+                    # round between the persisted and the failed best
+                    # still deserves a save
+                    try:
+                        # blocking: the marker must never describe a
+                        # snapshot that is still in flight
+                        self.best_snapshots.save(
+                            round_idx, self.state, wait=True
+                        )
+                        atomic_write_bytes(
+                            self.best_snapshots.directory / "best.json",
+                            _json.dumps(
+                                {"round": round_idx, "auc": float(auc)}
+                            ).encode(),
+                        )
+                        atomic_write_bytes(
+                            self.best_snapshots.directory / "config.json",
+                            cfg.to_json().encode(),
+                        )
+                        self._best_auc = float(auc)
+                    except OSError as e:
+                        print(
+                            f"[trainer] could not persist best snapshot "
+                            f"at round {round_idx}: {e}"
+                        )
                 if self.snapshots is not None and (
                     (round_idx + 1) % cfg.train.save_every == 0
                     or round_idx == cfg.fed.rounds - 1

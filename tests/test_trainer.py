@@ -939,3 +939,74 @@ def test_coordinator_cli_int8_compression(tmp_path):
     b = flat_global(int8[0] / "global_round_1.msgpack")
     assert np.max(np.abs(a - b)) < 0.02, np.max(np.abs(a - b))
     assert not np.array_equal(a, b)  # compression actually engaged
+
+
+def test_keep_best_snapshot_tracks_max_auc_and_survives_resume(tmp_path):
+    """train.keep_best writes a full best-AUC snapshot dir (incl. its own
+    config.json, so fedrec-recommend can serve it directly): the marker
+    names the argmax-AUC round of the run, and a resumed run loads the
+    incumbent best so a later worse round can never replace it."""
+    import json
+
+    from fedrec_tpu.train.checkpoint import SnapshotManager
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=4, train__save_every=1)
+    cfg.train.keep_best = True
+    cfg.train.eval_every = 1
+    data, token_states = tiny_data(cfg)
+    t = Trainer(cfg, data, token_states)
+    history = t.run()
+
+    best_dir = tmp_path / "best"
+    marker = json.loads((best_dir / "best.json").read_text())
+    aucs = [r.val_metrics["auc"] for r in history if r.val_metrics]
+    assert marker["auc"] == pytest.approx(max(aucs))
+    assert aucs[marker["round"]] == pytest.approx(max(aucs))
+    # a full snapshot dir: restorable and self-describing
+    assert (best_dir / "config.json").exists()
+    assert SnapshotManager(best_dir).latest_round() == marker["round"]
+
+    # resume: the incumbent best is loaded, not reset
+    cfg2 = tiny_cfg(tmp_path, fed__rounds=5, train__save_every=1)
+    cfg2.train.keep_best = True
+    cfg2.train.eval_every = 1
+    t2 = Trainer(cfg2, data, token_states)
+    assert t2._best_auc == pytest.approx(marker["auc"])
+    t2.run()
+    marker2 = json.loads((best_dir / "best.json").read_text())
+    assert marker2["auc"] >= marker["auc"]
+
+
+def test_keep_best_torn_marker_restarts_tracking(tmp_path):
+    """A marker that disagrees with the stored best round (crash between
+    the snapshot save and the marker write) must not seed _best_auc — the
+    stored snapshot's AUC is unknown, so tracking restarts and the next
+    improvement rewrites both coherently. A malformed marker (null auc)
+    degrades the same way instead of crashing __init__."""
+    import json
+
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, fed__rounds=2, train__save_every=1)
+    cfg.train.keep_best = True
+    cfg.train.eval_every = 1
+    data, token_states = tiny_data(cfg)
+    Trainer(cfg, data, token_states).run()
+
+    best_dir = tmp_path / "best"
+    marker = json.loads((best_dir / "best.json").read_text())
+    (best_dir / "best.json").write_text(
+        json.dumps({"round": marker["round"] + 7, "auc": 0.99})
+    )
+    cfg2 = tiny_cfg(tmp_path, fed__rounds=3, train__save_every=1)
+    cfg2.train.keep_best = True
+    cfg2.train.eval_every = 1
+    t = Trainer(cfg2, data, token_states)
+    assert t._best_auc is None
+
+    (best_dir / "best.json").write_text(json.dumps({"auc": None}))
+    cfg3 = tiny_cfg(tmp_path, fed__rounds=3, train__save_every=1)
+    cfg3.train.keep_best = True
+    t3 = Trainer(cfg3, data, token_states)
+    assert t3._best_auc is None
