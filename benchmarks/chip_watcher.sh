@@ -72,7 +72,7 @@ run_item() { # name timeout cmd...
 
 while :; do
   remaining=0
-  for n in bench pallas step_profile acc_bf16 serve acc_dp; do
+  for n in bench step_profile serve pallas acc_bf16 acc_dp; do
     [ -e "$MARK/$n" ] || remaining=$((remaining + 1))
   done
   if [ "$remaining" -eq 0 ]; then
@@ -81,11 +81,14 @@ while :; do
   fi
   if probe; then
     echo "[watcher] $(date -u +%FT%TZ) chip live; draining queue ($remaining left)"
+    # short, high-information items first: windows have measured ~20 min
+    # (2026-08-01 08:28-08:48Z window closed mid-bench), so the roofline
+    # verdict and the serving row must not queue behind an accuracy leg
     run_item bench 2400 python bench.py
-    run_item pallas 2400 python benchmarks/pallas_bench.py
     run_item step_profile 1800 python benchmarks/step_profile.py
-    run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
     run_item serve 1800 python benchmarks/serve_bench.py
+    run_item pallas 2400 python benchmarks/pallas_bench.py
+    run_item acc_bf16 3600 python benchmarks/accuracy_run.py --leg bf16
     # FEDREC_ACC_INNER=1: without it accuracy_run.py self-hardens by
     # re-exec'ing under JAX_PLATFORMS=cpu and the on-chip proof could
     # never bank (it would burn every window on a CPU run)
@@ -95,5 +98,7 @@ while :; do
   else
     echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"
   fi
-  sleep 600
+  # 5-min probe cadence: windows last ~20 min, a 10-min cadence can burn
+  # half a window before noticing it opened
+  sleep 300
 done
