@@ -187,6 +187,66 @@ def _baseline_ratios(
     return fields
 
 
+def _affects_measurement(path: str) -> bool:
+    """Paths the bench process actually loads: its own code, the framework,
+    the native engine, and the torch-baseline artifact baked into the
+    headline ratios. ``benchmarks/last_tpu_bench.json`` is the bench's own
+    OUTPUT and deliberately absent — every run dirties it."""
+    return (
+        path in ("bench.py", "benchmarks/baseline_host.json")
+        or path.startswith(("fedrec_tpu/", "native/"))
+    )
+
+
+def _cache_delta(
+    measured_commit: str,
+    repo_root: Path,
+    current_dirty_paths: list[str] | None,
+    measured_dirty_paths: list[str] | None = None,
+) -> dict:
+    """Annotate a cached-replay artifact with what changed since the measure.
+
+    ``cache_delta_is_measurement_affecting`` is the honest-staleness verdict:
+    True iff any changed path is one the bench process actually loads
+    (``_affects_measurement``), or a loading path was dirty at MEASURE time
+    (``measured_dirty_paths``) or is dirty NOW (``current_dirty_paths``) —
+    None for either means unknowable, which is not certifiable as clean.
+    Doc, test, and artifact churn
+    after a measurement does not change what was measured — the round-4
+    verdict had to treat a 29-commit docs+code mix as all-stale because the
+    artifact could not say. An artifact without the ``measured_dirty_paths``
+    stamp is unknowable-at-measure and therefore affecting (fail-unsafe);
+    every in-repo artifact carries the stamp.
+    """
+    try:
+        diff = subprocess.run(
+            # --no-renames: default rename detection prints only the
+            # destination, masking code moved OUT of a loading path
+            ["git", "diff", "--name-only", "--no-renames", "-z",
+             measured_commit, "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=20,
+        )
+        if diff.returncode != 0:
+            return {}
+        paths = sorted(p for p in diff.stdout.split("\0") if p)
+        affecting = [p for p in paths if _affects_measurement(p)]
+
+        def dirty_affecting(dp: list[str] | None) -> bool:
+            if dp is None:
+                return True  # unknowable -> not certifiable as clean
+            return any(_affects_measurement(p) for p in dp)
+
+        return {
+            "cache_delta_paths": paths,
+            "cache_delta_affecting_paths": affecting,
+            "cache_delta_is_measurement_affecting": bool(affecting)
+            or dirty_affecting(measured_dirty_paths)
+            or dirty_affecting(current_dirty_paths),
+        }
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def _promote_best_sweep_row(out: dict, sweep: dict, flops_of, peak, ratios) -> None:
     """Headline = the best sweep row, UNCONDITIONALLY once any sweep row
     exists (module docstring: B=64 is dispatch-bound over the tunnel and
@@ -488,17 +548,30 @@ def main() -> None:
         # let the reader check staleness at a glance: does the cached chip
         # measurement describe the tree being benched right now? (claimed
         # only for a CLEAN checkout at the measured commit)
-        from fedrec_tpu.utils.provenance import git_dirty, git_head
+        from fedrec_tpu.utils.provenance import git_dirty_paths, git_head
 
         head = git_head(Path(__file__).parent)
         if head != "unknown":
-            dirty = git_dirty(Path(__file__).parent)
+            dirty_paths = git_dirty_paths(Path(__file__).parent)
+            dirty = None if dirty_paths is None else bool(dirty_paths)
             suffix = {True: "-dirty", False: "", None: "-unknown"}[dirty]
             cached["bench_tree_commit"] = head + suffix
             mc = str(cached.get("measured_commit", "")).split()
             cached["cache_is_current_tree"] = (
                 bool(mc) and head[:7] == mc[0][:7] and dirty is False
             )
+            # when the cache is NOT the current tree, say exactly what
+            # changed since the measurement so a docs-only delta is
+            # distinguishable from a code delta without a git checkout
+            if mc and not cached["cache_is_current_tree"]:
+                cached.update(
+                    _cache_delta(
+                        mc[0],
+                        Path(__file__).parent,
+                        dirty_paths,
+                        cached.get("measured_dirty_paths"),
+                    )
+                )
         out["cpu_fallback_note"] = (
             "XLA:CPU on this 1-core host, NOT the framework's target: the "
             "vs_baseline ratio here compares JAX-CPU against the torch-CPU "
@@ -553,6 +626,11 @@ def main() -> None:
             stamp = provenance()
             out["measured_at"] = stamp["measured_at"]
             out["measured_commit"] = stamp["commit"]
+            # measure-time tree state, so a later cached replay can tell
+            # whether dirtiness at measure time could have affected the
+            # number (the bench's own artifact write always dirties the
+            # tree mid-run and must not read as staleness)
+            out["measured_dirty_paths"] = stamp.get("dirty_paths")
             out["provenance"] = stamp
             target = cache_path
             if (

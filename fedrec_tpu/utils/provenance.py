@@ -33,15 +33,31 @@ def git_dirty(repo: Path | None = None) -> bool | None:
     is "does the checkout still match the stamped commit's code", and a
     stray notes file answers nothing about that.
     """
+    paths = git_dirty_paths(repo)
+    return None if paths is None else bool(paths)
+
+
+def git_dirty_paths(repo: Path | None = None) -> list[str] | None:
+    """Tracked files with uncommitted changes at stamp time, None if unknown.
+
+    Recorded so a later reader can decide whether measure-time dirtiness
+    could have affected the measurement (e.g. a benchmark writing its own
+    artifact dirties the tree harmlessly; an edited ``fedrec_tpu/`` module
+    does not). ``-z`` (NUL-separated) because git C-quotes spaces and
+    non-ASCII in line-oriented output, which would defeat any prefix match
+    a consumer runs on these paths.
+    """
     try:
         out = subprocess.run(
-            ["git", "status", "--porcelain", "--untracked-files=no"],
+            # --no-renames: rename detection would print only the
+            # destination, hiding a source moved out of a watched prefix
+            ["git", "diff", "--name-only", "--no-renames", "-z", "HEAD"],
             capture_output=True, text=True, timeout=10,
             cwd=repo or Path(__file__).resolve().parents[2],
         )
         if out.returncode != 0:
             return None
-        return bool(out.stdout.strip())
+        return sorted(p for p in out.stdout.split("\0") if p)
     except Exception:  # noqa: BLE001
         return None
 
@@ -55,6 +71,7 @@ def provenance(**extra) -> dict:
         "hostname": _platform.node(),
         "machine": _platform.machine(),
         "nproc": os.cpu_count(),
+        "dirty_paths": git_dirty_paths(),
     }
     import sys
 

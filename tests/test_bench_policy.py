@@ -179,3 +179,167 @@ def test_promotion_clamp_uses_b64_flagship_when_small_b_rows_failed(tmp_path):
     assert out["value"] == 60000.0
     assert "ratio_rate_used" not in out
     assert "ratio_clamped_to_b" not in out
+
+
+# ---------------------------------------------------------------------------
+# _cache_delta: the cached-replay staleness annotation (round 5). The verdict
+# must be able to tell a docs-only delta from a code delta without a checkout.
+
+
+def _git(tmp, *args):
+    import subprocess
+
+    r = subprocess.run(
+        ["git", *args], cwd=tmp, capture_output=True, text=True, check=True
+    )
+    return r.stdout.strip()
+
+
+def _mini_repo(tmp_path):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "fedrec_tpu").mkdir()
+    (tmp_path / "fedrec_tpu" / "a.py").write_text("x = 1\n")
+    (tmp_path / "README.md").write_text("v1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "base")
+    return _git(tmp_path, "rev-parse", "HEAD")
+
+
+def test_cache_delta_docs_only_is_not_measurement_affecting(tmp_path):
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "README.md").write_text("v2\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "docs")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_paths"] == ["README.md"]
+    assert d["cache_delta_affecting_paths"] == []
+    assert d["cache_delta_is_measurement_affecting"] is False
+
+
+def test_cache_delta_code_change_is_measurement_affecting(tmp_path):
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "fedrec_tpu" / "a.py").write_text("x = 2\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "code")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_affecting_paths"] == ["fedrec_tpu/a.py"]
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_cache_delta_baseline_artifact_is_a_loading_path(tmp_path):
+    # benchmarks/baseline_host.json is baked into the cached headline's
+    # vs_baseline ratios: re-measuring the baseline must read as affecting
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "benchmarks").mkdir()
+    (tmp_path / "benchmarks" / "baseline_host.json").write_text("{}\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "rebaseline")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_affecting_paths"] == [
+        "benchmarks/baseline_host.json"
+    ]
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_cache_delta_spacey_doc_path_not_fragmented(tmp_path):
+    # "old bench.py" (a doc/scratch name containing a space) must not
+    # fragment into "bench.py" and read as a code change
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "old bench.py").write_text("# notes\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "scratch")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_affecting_paths"] == []
+    assert d["cache_delta_is_measurement_affecting"] is False
+
+
+def test_cache_delta_dirty_tree_rules(tmp_path):
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    # dirty in a loading path (now or at measure time) -> affecting;
+    # dirty only in the bench's own output artifact -> clean;
+    # unknowable (None, or a legacy artifact missing the stamp) -> affecting
+    assert _cache_delta(
+        base, tmp_path, ["fedrec_tpu/a.py"], measured_dirty_paths=[]
+    )["cache_delta_is_measurement_affecting"] is True
+    assert _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=["fedrec_tpu/a.py"]
+    )["cache_delta_is_measurement_affecting"] is True
+    assert _cache_delta(
+        base,
+        tmp_path,
+        ["benchmarks/last_tpu_bench.json"],
+        measured_dirty_paths=["benchmarks/last_tpu_bench.json"],
+    )["cache_delta_is_measurement_affecting"] is False
+    assert _cache_delta(base, tmp_path, None, measured_dirty_paths=[])[
+        "cache_delta_is_measurement_affecting"
+    ] is True
+    assert _cache_delta(base, tmp_path, [], measured_dirty_paths=None)[
+        "cache_delta_is_measurement_affecting"
+    ] is True
+    # absent stamp (default) is unknowable, not clean
+    assert _cache_delta(base, tmp_path, [])[
+        "cache_delta_is_measurement_affecting"
+    ] is True
+
+
+def test_cache_delta_bad_commit_returns_empty(tmp_path):
+    from bench import _cache_delta
+
+    _mini_repo(tmp_path)
+    assert _cache_delta("0000000", tmp_path, []) == {}
+
+
+def test_cache_delta_nonascii_code_path_not_quote_masked(tmp_path):
+    # git C-quotes non-ASCII paths in line-oriented output; the -z parse
+    # must still classify a real fedrec_tpu/ change as affecting
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "fedrec_tpu" / "résumé.py").write_text("y = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "code")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_affecting_paths"] == ["fedrec_tpu/résumé.py"]
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_git_dirty_paths_unquoted_with_spaces(tmp_path):
+    from fedrec_tpu.utils.provenance import git_dirty_paths
+
+    _mini_repo(tmp_path)
+    (tmp_path / "fedrec_tpu" / "a b.py").write_text("z = 1\n")
+    _git(tmp_path, "add", "fedrec_tpu/a b.py")
+    assert git_dirty_paths(tmp_path) == ["fedrec_tpu/a b.py"]
+
+
+def test_cache_delta_rename_out_of_loading_path_still_affecting(tmp_path):
+    # `git mv fedrec_tpu/a.py attic.md` must report the SOURCE too:
+    # default rename detection prints only the destination
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    _git(tmp_path, "mv", "fedrec_tpu/a.py", "attic.md")
+    _git(tmp_path, "commit", "-qm", "move out")
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert "fedrec_tpu/a.py" in d["cache_delta_affecting_paths"]
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_git_dirty_paths_records_staged_rename_source(tmp_path):
+    from fedrec_tpu.utils.provenance import git_dirty_paths
+
+    _mini_repo(tmp_path)
+    _git(tmp_path, "mv", "fedrec_tpu/a.py", "notes.md")
+    assert "fedrec_tpu/a.py" in git_dirty_paths(tmp_path)
