@@ -678,7 +678,7 @@ def main() -> None:
                 ratios=baseline_ratios,
             )
 
-        for bsz in (128, 256, 512, 1024, 2048, 4096):
+        for bsz in (128, 256, 512, 1024, 2048, 4096, 8192):
             try:
                 dt_b = measure(bsz, iters=20)
                 sweep[str(bsz)] = round(bsz / dt_b, 2)

@@ -39,8 +39,12 @@ verify_pallas() { # refuses to run off-TPU, so its table implies the chip
 # that produced no fresh chip evidence) and stamped with a real chip backend.
 # CPU fallbacks write *_cpu.json siblings, leaving these untouched.
 verify_json_artifact() { # artifact_path item_name
+  # "partial": the harness stamps incrementally so a mid-run wedge keeps
+  # its completed rows as labeled evidence — but the item banks (stops
+  # retrying) only on a COMPLETE run
   [ "$1" -nt "$MARK/.start_$2" ] 2>/dev/null \
-    && grep -q '"jax_backend": "tpu"' "$1"
+    && grep -q '"jax_backend": "tpu"' "$1" \
+    && ! grep -q '"partial": true' "$1"
 }
 verify_step_profile() {
   verify_json_artifact benchmarks/step_profile.json step_profile

@@ -142,6 +142,25 @@ def main() -> int:
     B, heads, dk, D, hidden = args.batch, 20, 20, 400, 200
     rows = []
 
+    from fedrec_tpu.utils.provenance import provenance, write_artifact
+
+    def _stamp(partial: bool) -> None:
+        # incremental banking: tunnel windows are ~20 min and wedge mid-run;
+        # every measured row must survive a stall. The watcher re-runs the
+        # queue item until a run completes (banking keys off the final
+        # stdout table), but a partial artifact is still labeled evidence.
+        write_artifact(Path(__file__).with_name("pallas_bench.json"), {
+            "platform": platform, "batch": B,
+            "rows": [
+                {"op": name, "H": H,
+                 "xla_ms": t_x and t_x * 1e3,
+                 "pallas_ms": t_p and t_p * 1e3,
+                 "chunked_ms": t_c and t_c * 1e3}
+                for name, H, t_x, t_p, t_c in rows
+            ],
+            "skipped": skips, "provenance": provenance(),
+        }, partial)
+
     for H in (50, 1024, 2048, 4096):
         rng = np.random.default_rng(0)
         q = jnp.asarray(rng.standard_normal((B, H, heads, dk)).astype(np.float32))
@@ -172,6 +191,7 @@ def main() -> int:
                      try_time(f"xla/bwd/{H}", g_of(dense_attn), q, k, v, mask),
                      try_time(f"pallas/bwd/{H}", g_of(flash_attention), q, k, v, mask),
                      try_time(f"chunked/bwd/{H}", g_of(chunked_attention), q, k, v, mask)))
+        _stamp(partial=True)
 
         if H >= 2048:
             continue  # pool is O(L)-memory everywhere; 2 sizes suffice
@@ -199,6 +219,7 @@ def main() -> int:
                 lambda x: additive_pool(x, w1, b1, w2, m).sum())(x)), x, mask),
             None,
         ))
+        _stamp(partial=True)
 
     def fmt(t):
         return f"{t*1e3:.3f}" if t is not None else "OOM/–"
@@ -207,20 +228,10 @@ def main() -> int:
           f"({getattr(jax.devices()[0], 'device_kind', '?')}), B={B}\n")
     print("| op | H | xla dense ms | pallas ms | chunked ms |")
     print("|---|---|---|---|---|")
-    out = []
     for name, H, t_x, t_p, t_c in rows:
         print(f"| {name} | {H} | {fmt(t_x)} | {fmt(t_p)} | {fmt(t_c)} |")
-        out.append({"op": name, "H": H,
-                    "xla_ms": t_x and t_x * 1e3,
-                    "pallas_ms": t_p and t_p * 1e3,
-                    "chunked_ms": t_c and t_c * 1e3})
 
-    from fedrec_tpu.utils.provenance import provenance
-
-    Path(__file__).with_name("pallas_bench.json").write_text(
-        json.dumps({"platform": platform, "batch": B, "rows": out,
-                    "skipped": skips, "provenance": provenance()}, indent=2)
-    )
+    _stamp(partial=False)
     return 0
 
 

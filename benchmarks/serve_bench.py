@@ -78,7 +78,29 @@ def main() -> int:
             best = min(best, time.perf_counter() - t0)
         return best
 
+    from fedrec_tpu.utils.provenance import provenance, write_artifact
+
+    name = "serve_bench_cpu.json" if on_cpu else "serve_bench.json"
     out_rows = {}
+    sharded_rows = {"batches": {}}
+
+    def _stamp(partial: bool) -> None:
+        # incremental banking: a tunnel wedge mid-run must not discard the
+        # rows already measured (windows last ~20 min). The watcher banks
+        # the queue item only when "partial" is absent.
+        write_artifact(Path(__file__).with_name(name), {
+            "metric": "recommend_throughput",
+            "unit": "users/sec",
+            "num_news": N,
+            "news_dim": D,
+            "top_k": args.top_k,
+            "his_len": H,
+            "dtype": cfg.model.dtype,
+            "batches": out_rows,
+            "sharded": sharded_rows,
+            "provenance": provenance(),
+        }, partial)
+
     for B in (1, 64, 256, 1024):
         history = jnp.asarray(
             rng.integers(1, N, (B, H)).astype(np.int32)
@@ -97,6 +119,7 @@ def main() -> int:
             "ms_per_batch": round(dt * 1e3, 3),
         }
         print(f"B={B:5d}  {B/dt:12.1f} users/s  ({dt*1e3:.3f} ms)", flush=True)
+        _stamp(partial=True)
 
     # mesh-sharded scorer (serve.build_recommend_fn_sharded): catalog +
     # score matrix split over every device, local top-k + gather merge.
@@ -108,7 +131,7 @@ def main() -> int:
 
     mesh = client_mesh(len(jax.devices()))
     sfn = build_recommend_fn_sharded(model, mesh, top_k=args.top_k)
-    sharded_rows = {"n_devices": mesh.size, "batches": {}}
+    sharded_rows["n_devices"] = mesh.size
     if on_cpu and mesh.size > 1:
         sharded_rows["note"] = (
             f"{mesh.size} FAKE devices on 1 physical core: this row "
@@ -138,6 +161,7 @@ def main() -> int:
         }
         print(f"B={B:5d} sharded x{mesh.size}  {B/dt:10.1f} users/s",
               flush=True)
+        _stamp(partial=True)
 
     # when does sharded win? One (B, k) all_gather per query vs splitting
     # the (N, D) table + (B, N) scores — a CHIP-sizing question, so the
@@ -164,21 +188,7 @@ def main() -> int:
     sharded_rows["verdict"] = verdict
     print(f"[serve] {verdict}", flush=True)
 
-    from fedrec_tpu.utils.provenance import provenance
-
-    name = "serve_bench_cpu.json" if on_cpu else "serve_bench.json"
-    Path(__file__).with_name(name).write_text(json.dumps({
-        "metric": "recommend_throughput",
-        "unit": "users/sec",
-        "num_news": N,
-        "news_dim": D,
-        "top_k": args.top_k,
-        "his_len": H,
-        "dtype": cfg.model.dtype,
-        "batches": out_rows,
-        "sharded": sharded_rows,
-        "provenance": provenance(),
-    }, indent=2))
+    _stamp(partial=False)
     return 0
 
 

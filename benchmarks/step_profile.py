@@ -113,160 +113,20 @@ def main() -> int:
     def att_hidden_bytes() -> int:
         return (Dh // 2) * dt_bytes
 
-    out_all = {}
-    batches = (64,) if on_cpu else (64, 1024)
-    for B in batches:
-        candidates = jnp.asarray(
-            rng.integers(0, num_news, (B, C)).astype(np.int32)
-        )
-        history = jnp.asarray(
-            rng.integers(0, num_news, (B, H)).astype(np.int32)
-        )
-        labels = jnp.zeros((B,), jnp.int32)
-        size = B * (C + H)
-        U = min(size, num_news)
-        flat_ids = jnp.concatenate(
-            [candidates.reshape(-1), history.reshape(-1)]
-        )
-
-        # ---- components (first arg is the one _time perturbs/chains on)
-        def gather_only(ts):
-            uniq, inv = jnp.unique(flat_ids, size=U, fill_value=0,
-                                   return_inverse=True)
-            return ts[uniq].sum()
-
-        def unique_only(ids_f32):
-            # float so the chain perturbation type-checks; cast back
-            uniq, inv = jnp.unique(ids_f32.astype(jnp.int32), size=U,
-                                   fill_value=0, return_inverse=True)
-            return uniq.sum() + inv.sum()
-
-        def text_fwd(ts):
-            uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
-                                 return_inverse=True)
-            return model.apply({"params": {"text_head": text_p}}, ts[uniq],
-                               method=NewsRecommender.encode_news).sum()
-
-        def text_fwd_bwd(ts):
-            def loss(p):
-                uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
-                                     return_inverse=True)
-                return model.apply({"params": {"text_head": p}}, ts[uniq],
-                                   method=NewsRecommender.encode_news).sum()
-            g = jax.grad(loss)(text_p)
-            # sum EVERY leaf: a single bias-grad leaf can be input-
-            # independent, letting XLA fold the chained body to a constant
-            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-        cand_vecs, his_vecs = _batch_news_vecs(
-            model, text_p, token_states, candidates, history
-        )
-
-        # the chain timer perturbs the FIRST argument; it must be the
-        # HISTORY vecs — the self-attention (the user tower's dominant
-        # cost) runs over his_vecs alone, and with cand_vecs as the
-        # perturbed arg XLA hoists the whole loop-invariant attention out
-        # of the chain (measured: 0.019 ms "user_fwd" on CPU)
-        def user_fwd(hv):
-            return model.apply(
-                {"params": {"user_encoder": user_p}}, cand_vecs, hv
-            ).sum()
-
-        def user_fwd_bwd(hv):
-            def loss(p):
-                scores = model.apply(
-                    {"params": {"user_encoder": p}}, cand_vecs, hv
-                )
-                return score_loss(scores, labels)
-            g = jax.grad(loss)(user_p)
-            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-        def full_fwd_bwd(ts):
-            def loss(ps):
-                cv, hv = _batch_news_vecs(
-                    model, ps["text"], ts, candidates, history
-                )
-                scores = model.apply(
-                    {"params": {"user_encoder": ps["user"]}}, cv, hv
-                )
-                return score_loss(scores, labels)
-            g = jax.grad(loss)({"text": text_p, "user": user_p})
-            return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-        comps = {
-            "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
-            "gather_only": (gather_only, token_states),
-            "text_fwd": (text_fwd, token_states),
-            "text_fwd_bwd": (text_fwd_bwd, token_states),
-            "user_fwd": (user_fwd, his_vecs),
-            "user_fwd_bwd": (user_fwd_bwd, his_vecs),
-            "full_fwd_bwd": (full_fwd_bwd, token_states),
-        }
-        if B == 64:
-            def full_fwd_bwd_capped(ts):
-                # the FLAGSHIP configuration: unique-news cap 2560 (bench.py)
-                def loss(ps):
-                    cv, hv = _batch_news_vecs(
-                        model, ps["text"], ts, candidates, history, cap=2560
-                    )
-                    scores = model.apply(
-                        {"params": {"user_encoder": ps["user"]}}, cv, hv
-                    )
-                    return score_loss(scores, labels)
-                g = jax.grad(loss)({"text": text_p, "user": user_p})
-                return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
-
-            comps["full_fwd_bwd_capped"] = (full_fwd_bwd_capped, token_states)
-
-        res = {}
-        for name, (fn, arg0) in comps.items():
-            t = _time(jax.jit(fn), arg0, iters=3 if on_cpu else 30)
-            res[name] = round(t * 1e3, 4)
-            print(f"B={B:5d} {name:22s} {t*1e3:9.3f} ms", flush=True)
-
-        entry = {"components_ms": res}
-        if on_cpu:
-            # seconds-long CPU components at iters=3 on a shared 1-core
-            # host carry ~±10% run-to-run noise — enough for a component
-            # to read slower than the full step it decomposes; say so in
-            # the artifact rather than pay minutes per extra iteration
-            entry["cpu_noise_note"] = (
-                "components measured at iters=3 on a 1-core host: ~±10% "
-                "noise, so component/full-step shares are indicative "
-                "only; compute shares from the chip artifact "
-                "(step_profile.json)"
-            )
-        # roofline for the full step at this B
-        t_full = res["full_fwd_bwd"] / 1e3
-        fl, by = flops_of(B, U), bytes_of(B, U)
-        entry["model_flops"] = fl
-        entry["model_hbm_bytes"] = by
-        entry["arithmetic_intensity"] = round(fl / by, 2)
-        if peaks is not None:
-            peak_fl = peaks[0] if cfg.model.dtype == "bfloat16" else peaks[1]
-            peak_bw = peaks[2]
-            entry["mfu"] = round(fl / t_full / peak_fl, 4)
-            entry["hbm_fraction"] = round(by / t_full / peak_bw, 4)
-            entry["ridge_intensity"] = round(peak_fl / peak_bw, 1)
-            bound = (
-                "memory-bound" if entry["hbm_fraction"] >= 0.6
-                else "compute-bound" if entry["mfu"] >= 0.6
-                else "neither peak approached: dispatch/latency/fusion "
-                     "headroom"
-            )
-            entry["verdict"] = bound
-            print(f"B={B:5d} roofline: MFU {entry['mfu']:.3f}, "
-                  f"HBM {entry['hbm_fraction']:.3f} of peak -> {bound}",
-                  flush=True)
-        out_all[str(B)] = entry
-
-    from fedrec_tpu.utils.provenance import provenance
+    from fedrec_tpu.utils.provenance import provenance, write_artifact
 
     # CPU profiles land in their own artifact so a future chip run never
     # gets shadowed (and vice versa)
     name = "step_profile_cpu.json" if on_cpu else "step_profile.json"
-    Path(__file__).with_name(name).write_text(
-        json.dumps({
+
+    out_all = {}
+
+    def _stamp(partial: bool) -> None:
+        # incremental banking: tunnel windows have measured ~20 min and can
+        # wedge mid-run — every completed row must survive a stall. The
+        # watcher banks the queue item only when "partial" is absent, so an
+        # interrupted run leaves usable evidence AND retries.
+        write_artifact(Path(__file__).with_name(name), {
             "dtype": cfg.model.dtype,
             "batches": out_all,
             "bytes_model_assumptions": (
@@ -277,9 +137,167 @@ def main() -> int:
                 "index traffic ignored"
             ),
             "provenance": provenance(),
-        }, indent=2)
-    )
+        }, partial)
+
+    batches = (64,) if on_cpu else (64, 1024, 4096)
+    for B in batches:
+        try:
+            candidates = jnp.asarray(
+                rng.integers(0, num_news, (B, C)).astype(np.int32)
+            )
+            history = jnp.asarray(
+                rng.integers(0, num_news, (B, H)).astype(np.int32)
+            )
+            labels = jnp.zeros((B,), jnp.int32)
+            size = B * (C + H)
+            U = min(size, num_news)
+            flat_ids = jnp.concatenate(
+                [candidates.reshape(-1), history.reshape(-1)]
+            )
+
+            # ---- components (first arg is the one _time perturbs/chains on)
+            def gather_only(ts):
+                uniq, inv = jnp.unique(flat_ids, size=U, fill_value=0,
+                                       return_inverse=True)
+                return ts[uniq].sum()
+
+            def unique_only(ids_f32):
+                # float so the chain perturbation type-checks; cast back
+                uniq, inv = jnp.unique(ids_f32.astype(jnp.int32), size=U,
+                                       fill_value=0, return_inverse=True)
+                return uniq.sum() + inv.sum()
+
+            def text_fwd(ts):
+                uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
+                                     return_inverse=True)
+                return model.apply({"params": {"text_head": text_p}}, ts[uniq],
+                                   method=NewsRecommender.encode_news).sum()
+
+            def text_fwd_bwd(ts):
+                def loss(p):
+                    uniq, _ = jnp.unique(flat_ids, size=U, fill_value=0,
+                                         return_inverse=True)
+                    return model.apply({"params": {"text_head": p}}, ts[uniq],
+                                       method=NewsRecommender.encode_news).sum()
+                g = jax.grad(loss)(text_p)
+                # sum EVERY leaf: a single bias-grad leaf can be input-
+                # independent, letting XLA fold the chained body to a constant
+                return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+            cand_vecs, his_vecs = _batch_news_vecs(
+                model, text_p, token_states, candidates, history
+            )
+
+            # the chain timer perturbs the FIRST argument; it must be the
+            # HISTORY vecs — the self-attention (the user tower's dominant
+            # cost) runs over his_vecs alone, and with cand_vecs as the
+            # perturbed arg XLA hoists the whole loop-invariant attention out
+            # of the chain (measured: 0.019 ms "user_fwd" on CPU)
+            def user_fwd(hv):
+                return model.apply(
+                    {"params": {"user_encoder": user_p}}, cand_vecs, hv
+                ).sum()
+
+            def user_fwd_bwd(hv):
+                def loss(p):
+                    scores = model.apply(
+                        {"params": {"user_encoder": p}}, cand_vecs, hv
+                    )
+                    return score_loss(scores, labels)
+                g = jax.grad(loss)(user_p)
+                return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+            def full_fwd_bwd(ts):
+                def loss(ps):
+                    cv, hv = _batch_news_vecs(
+                        model, ps["text"], ts, candidates, history
+                    )
+                    scores = model.apply(
+                        {"params": {"user_encoder": ps["user"]}}, cv, hv
+                    )
+                    return score_loss(scores, labels)
+                g = jax.grad(loss)({"text": text_p, "user": user_p})
+                return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+            comps = {
+                "unique_only": (unique_only, flat_ids.astype(jnp.float32)),
+                "gather_only": (gather_only, token_states),
+                "text_fwd": (text_fwd, token_states),
+                "text_fwd_bwd": (text_fwd_bwd, token_states),
+                "user_fwd": (user_fwd, his_vecs),
+                "user_fwd_bwd": (user_fwd_bwd, his_vecs),
+                "full_fwd_bwd": (full_fwd_bwd, token_states),
+            }
+            if B == 64:
+                def full_fwd_bwd_capped(ts):
+                    # the FLAGSHIP configuration: unique-news cap 2560 (bench.py)
+                    def loss(ps):
+                        cv, hv = _batch_news_vecs(
+                            model, ps["text"], ts, candidates, history, cap=2560
+                        )
+                        scores = model.apply(
+                            {"params": {"user_encoder": ps["user"]}}, cv, hv
+                        )
+                        return score_loss(scores, labels)
+                    g = jax.grad(loss)({"text": text_p, "user": user_p})
+                    return sum(l.sum() for l in jax.tree_util.tree_leaves(g))
+
+                comps["full_fwd_bwd_capped"] = (full_fwd_bwd_capped, token_states)
+
+            res = {}
+            entry = {"components_ms": res}
+            out_all[str(B)] = entry
+            for comp_name, (fn, arg0) in comps.items():
+                t = _time(jax.jit(fn), arg0, iters=3 if on_cpu else 30)
+                res[comp_name] = round(t * 1e3, 4)
+                print(f"B={B:5d} {comp_name:22s} {t*1e3:9.3f} ms", flush=True)
+                _stamp(partial=True)
+            if on_cpu:
+                # seconds-long CPU components at iters=3 on a shared 1-core
+                # host carry ~±10% run-to-run noise — enough for a component
+                # to read slower than the full step it decomposes; say so in
+                # the artifact rather than pay minutes per extra iteration
+                entry["cpu_noise_note"] = (
+                    "components measured at iters=3 on a 1-core host: ~±10% "
+                    "noise, so component/full-step shares are indicative "
+                    "only; compute shares from the chip artifact "
+                    "(step_profile.json)"
+                )
+            # roofline for the full step at this B
+            t_full = res["full_fwd_bwd"] / 1e3
+            fl, by = flops_of(B, U), bytes_of(B, U)
+            entry["model_flops"] = fl
+            entry["model_hbm_bytes"] = by
+            entry["arithmetic_intensity"] = round(fl / by, 2)
+            if peaks is not None:
+                peak_fl = peaks[0] if cfg.model.dtype == "bfloat16" else peaks[1]
+                peak_bw = peaks[2]
+                entry["mfu"] = round(fl / t_full / peak_fl, 4)
+                entry["hbm_fraction"] = round(by / t_full / peak_bw, 4)
+                entry["ridge_intensity"] = round(peak_fl / peak_bw, 1)
+                bound = (
+                    "memory-bound" if entry["hbm_fraction"] >= 0.6
+                    else "compute-bound" if entry["mfu"] >= 0.6
+                    else "neither peak approached: dispatch/latency/fusion "
+                         "headroom"
+                )
+                entry["verdict"] = bound
+                print(f"B={B:5d} roofline: MFU {entry['mfu']:.3f}, "
+                      f"HBM {entry['hbm_fraction']:.3f} of peak -> {bound}",
+                      flush=True)
+            _stamp(partial=True)
+        except Exception as e:  # noqa: BLE001
+            # a deterministic per-B failure (e.g. an OOM at the new large-B
+            # leg) must not leave the artifact permanently partial — record
+            # the skip and let the run COMPLETE so the queue item banks
+            out_all[str(B)] = {"skipped": f"{type(e).__name__}: {str(e)[:160]}"}
+            print(f"B={B:5d} SKIPPED: {type(e).__name__}: {str(e)[:140]}",
+                  flush=True)
+            _stamp(partial=True)
+
+    _stamp(partial=False)
     return 0
+
 
 
 if __name__ == "__main__":

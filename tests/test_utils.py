@@ -127,3 +127,22 @@ def test_git_provenance_helpers(tmp_path):
     bare.mkdir()
     assert git_head(bare) == "unknown"
     assert git_dirty(bare) is None
+
+
+def test_write_artifact_partial_first_and_atomic(tmp_path):
+    # "partial" must be the FIRST serialized key (a torn tail then cannot
+    # keep the provenance block while dropping the flag) and the write must
+    # leave no temp file behind
+    import json
+
+    from fedrec_tpu.utils.provenance import write_artifact
+
+    p = tmp_path / "art.json"
+    write_artifact(p, {"a": 1, "provenance": {"jax_backend": "tpu"}}, True)
+    raw = p.read_text()
+    assert raw.index('"partial"') < raw.index('"provenance"')
+    assert json.loads(raw)["partial"] is True
+    write_artifact(p, {"a": 2}, False)
+    d = json.loads(p.read_text())
+    assert "partial" not in d and d["a"] == 2
+    assert list(tmp_path.iterdir()) == [p]
