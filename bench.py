@@ -342,8 +342,16 @@ def main() -> None:
                 )
                 if proc.stderr:
                     sys.stderr.write(proc.stderr[-2000:] + "\n")
-            except subprocess.TimeoutExpired:
+            except subprocess.TimeoutExpired as e:
                 sys.stderr.write("[bench] tpu run timed out; cpu fallback\n")
+                # surface the wedged child's progress markers (e.g.
+                # FEDREC_BENCH_TRACE) — the one case an operator most
+                # needs them is exactly this one
+                tail = e.stderr or b""
+                if isinstance(tail, bytes):
+                    tail = tail.decode(errors="replace")
+                if tail:
+                    sys.stderr.write(tail[-2000:] + "\n")
         else:
             # say so explicitly: a silent fall-through here is
             # indistinguishable from "probe never attempted" in the logs
@@ -373,6 +381,27 @@ def main() -> None:
     if on_tpu:
         cfg.model.dtype = "bfloat16"  # MXU-native; params/opt stay f32
     num_news, L = 4096, cfg.data.max_title_len
+    # FEDREC_BENCH_SMOKE=1 (CPU-only test hook): tiny shapes + short chains
+    # so the integration test of the cached-replay path finishes in seconds
+    # instead of minutes. Deliberately IGNORED on TPU — a real-chip artifact
+    # must never be produced at smoke scale.
+    smoke = (not on_tpu) and os.environ.get("FEDREC_BENCH_SMOKE") == "1"
+    if smoke:
+        cfg.data.batch_size = 8
+        num_news = 256
+    # FEDREC_BENCH_TRACE=1: stderr progress markers inside measure() — the
+    # tool that located a chain-growth explosion; costs nothing when off
+    if os.environ.get("FEDREC_BENCH_TRACE") == "1":
+        _tt0 = time.time()
+
+        def _tr(msg: str) -> None:
+            sys.stderr.write(f"[trace {time.time() - _tt0:7.1f}s] {msg}\n")
+            sys.stderr.flush()
+
+        _tr(f"shapes B={cfg.data.batch_size} num_news={num_news} smoke={smoke}")
+    else:
+        def _tr(msg: str) -> None:
+            pass
     B, C, H = cfg.data.batch_size, 1 + cfg.data.npratio, cfg.data.max_his_len
 
     rng = np.random.default_rng(0)
@@ -439,7 +468,9 @@ def main() -> None:
             np.asarray(metrics["loss"])  # readback = real synchronization
             return time.perf_counter() - t0
 
+        _tr(f"measure(bsz={bsz}, iters={iters}) warmup start")
         chain(warmup)  # compile + steady-state
+        _tr("warmup done")
         # the differenced signal must dwarf RTT jitter, not merely be
         # positive — a tiny positive delta over-reports throughput as badly
         # as the clamp this replaced; grow the chain until it does
@@ -447,9 +478,18 @@ def main() -> None:
             t1 = chain(iters)
             t2 = chain(2 * iters)
             delta = t2 - t1
+            _tr(f"t1={t1:.2f} t2={t2:.2f} delta={delta:.2f} iters={iters}")
             if delta >= 0.3:
                 return delta / iters
-            per_step = max(delta / iters, 1e-7)
+            if delta <= 0:
+                # nonsense sign: compile/dispatch residue from a short
+                # warmup landed in the 1x chain (observed on the CPU
+                # fallback). The 0.3/per_step growth rule would explode
+                # straight to the 2000-iter cap — hours at CPU step times;
+                # double and re-measure instead
+                iters = min(2000, 2 * iters)
+                continue
+            per_step = delta / iters
             iters = int(min(2000, max(2 * iters, 0.3 / per_step)))
         raise RuntimeError(
             f"differenced step time never cleared the jitter floor "
@@ -506,7 +546,12 @@ def main() -> None:
 
     # CPU fallback: ~4 s/step, so short chains already dwarf timer noise —
     # long ones would blow the driver's wall-clock budget
-    dt = measure(B, iters=50 if on_tpu else 5, the_step=step_flag)
+    dt = measure(
+        B,
+        iters=50 if on_tpu else (2 if smoke else 5),
+        warmup=2 if smoke else 3,
+        the_step=step_flag,
+    )
     samples_per_sec = B / dt
 
     out = {
@@ -523,6 +568,11 @@ def main() -> None:
         "headline_source": "flagship_b64",
         "baseline": "torch-cpu reference-equivalent, see benchmarks/baseline_host.json",
     }
+    if smoke:
+        out["smoke"] = (
+            "FEDREC_BENCH_SMOKE test artifact: tiny shapes/short chains — "
+            "exists only to integration-test the output paths; never quote"
+        )
 
     baseline_path = Path(__file__).parent / "benchmarks" / "baseline_host.json"
 

@@ -96,7 +96,14 @@ def _time(fn, *args, iters: int = 30) -> float:
         delta = t2 - t1
         if delta >= target or measured_iters >= 2000:
             break
-        per_op = max(delta / measured_iters, 1e-7)
+        if delta <= 0:
+            # nonsense sign (jitter or warm-up residue in the 1x chain):
+            # the old 1e-7 floor jumped straight to the 2000-iter cap —
+            # hours at slow step times; double and re-measure instead.
+            # Kept in lockstep with bench.py measure() (see NOTE above).
+            iters = min(2000, 2 * measured_iters)
+            continue
+        per_op = delta / measured_iters
         iters = int(min(2000, max(2 * measured_iters, target / per_op)))
     if delta <= 0:
         raise RuntimeError(
