@@ -289,8 +289,15 @@ def leg_bf16(rounds: int) -> None:
     }
     out["provenance"] = _prov()
 
-    def persist(_partial=None):
-        (HERE / "accuracy_bf16.json").write_text(json.dumps(out, indent=2))
+    from fedrec_tpu.utils.provenance import write_artifact
+
+    def persist(final: bool = False) -> None:
+        # incremental, but write_artifact stages non-final stamps in an
+        # .inprogress sidecar until BOTH dtypes finished and the tolerance
+        # verdict is in — the watcher must not bank a half-trained
+        # comparison as the dtype-safety proof, and a wedged re-run must
+        # not clobber previously banked complete evidence
+        write_artifact(HERE / "accuracy_bf16.json", out, not final)
 
     for dtype in ("float32", "bfloat16"):
         print(f"[bf16-leg] training dtype={dtype}", flush=True)
@@ -303,7 +310,7 @@ def leg_bf16(rounds: int) -> None:
     out["final_auc"] = {"float32": f32_auc, "bfloat16": bf16_auc}
     out["auc_delta"] = round(abs(f32_auc - bf16_auc), 5)
     out["within_tolerance"] = out["auc_delta"] <= tolerance
-    persist()
+    persist(final=True)
     print(json.dumps({"leg": "bf16", "auc_f32": f32_auc, "auc_bf16": bf16_auc,
                       "delta": out["auc_delta"],
                       "within_tolerance": out["within_tolerance"]}))
@@ -560,19 +567,17 @@ def leg_dp(rounds: int) -> None:
     rows = (
         {n: DP_ROWS[n] for n in row_filter} if row_filter else DP_ROWS
     )
-    for name, spec in rows.items():
-        cfg = dp_row_cfg(name, rounds, len(data.train_samples))
-        runs[name] = _train(cfg, data, states)
-        runs[name]["epsilon"] = spec.get("eps")
-        runs[name]["sigma"] = (
-            round(cfg.privacy.sigma, 4) if spec.get("eps") else 0.0
-        )
-        runs[name]["dp_scope"] = cfg.privacy.dp_scope
-        runs[name]["batch_size"] = cfg.data.batch_size
-        print(f"[dp] {name}: final "
-              f"{runs[name]['curve'][-1] if runs[name]['curve'] else '?'}")
 
-    anchor = runs["nodp_tuned"]["curve"][-1]["auc"]
+    from fedrec_tpu.utils.provenance import write_artifact
+
+    # only the FULL sweep on the cpu rig may update the canonical artifact
+    # the report reads. A chip run (VERDICT r4 #7) — and equally a wedge
+    # CPU-fallback of the chip queue item, which still carries the row
+    # subset — goes to its own file; the watcher banks it only when its
+    # provenance proves a tpu backend AND the run completed (no "partial").
+    full_cpu = not row_filter and jax.devices()[0].platform == "cpu"
+    name = "accuracy_dp.json" if full_cpu else "accuracy_dp_tpu.json"
+
     out = {
         "leg": "dp",
         "platform": jax.devices()[0].platform,
@@ -589,28 +594,45 @@ def leg_dp(rounds: int) -> None:
             "rounds": rounds, "delta": 1e-5,
         },
         "oracle_auc": round(oracle_auc(data, states), 4),
-        "nodp_anchor_auc": anchor,
         "runs": runs,
-        "gap_to_anchor": {
-            n: round(anchor - r["curve"][-1]["auc"], 4)
-            for n, r in runs.items()
-            if DP_ROWS[n].get("eps") is not None and r["curve"]
-        },
+    }
+
+    def persist(partial: bool) -> None:
+        # per-row incremental banking: a ~20-min tunnel window cannot fit
+        # the whole leg; a wedge mid-leg must keep the rows already trained
+        # as labeled evidence. write_artifact stages partial stamps in an
+        # .inprogress sidecar, so a wedged RE-run can never destroy
+        # previously banked complete evidence; the watcher retries until
+        # the canonical artifact completes.
+        out["provenance"] = _prov()
+        write_artifact(HERE / name, out, partial)
+
+    for row_name, spec in rows.items():
+        cfg = dp_row_cfg(row_name, rounds, len(data.train_samples))
+        runs[row_name] = _train(cfg, data, states)
+        runs[row_name]["epsilon"] = spec.get("eps")
+        runs[row_name]["sigma"] = (
+            round(cfg.privacy.sigma, 4) if spec.get("eps") else 0.0
+        )
+        runs[row_name]["dp_scope"] = cfg.privacy.dp_scope
+        runs[row_name]["batch_size"] = cfg.data.batch_size
+        print(f"[dp] {row_name}: final "
+              f"{runs[row_name]['curve'][-1] if runs[row_name]['curve'] else '?'}")
+        persist(partial=True)
+
+    anchor = runs["nodp_tuned"]["curve"][-1]["auc"]
+    out["nodp_anchor_auc"] = anchor
+    out["gap_to_anchor"] = {
+        n: round(anchor - r["curve"][-1]["auc"], 4)
+        for n, r in runs.items()
+        if DP_ROWS[n].get("eps") is not None and r["curve"]
     }
     if "nodp_user_frozen" in runs and runs["nodp_user_frozen"]["curve"]:
         # the scope lever's hard ceiling, stated next to the rows it bounds
         out["user_frozen_ceiling_auc"] = (
             runs["nodp_user_frozen"]["curve"][-1]["auc"]
         )
-    out["provenance"] = _prov()
-    # only the FULL sweep on the cpu rig may update the canonical artifact
-    # the report reads. A chip run (VERDICT r4 #7) — and equally a wedge
-    # CPU-fallback of the chip queue item, which still carries the row
-    # subset — goes to its own file; the watcher banks it only when its
-    # provenance proves a tpu backend (verify_acc_dp).
-    full_cpu = not row_filter and jax.devices()[0].platform == "cpu"
-    name = "accuracy_dp.json" if full_cpu else "accuracy_dp_tpu.json"
-    (HERE / name).write_text(json.dumps(out, indent=2))
+    persist(partial=False)
 
 
 def leg_adressa(rounds: int) -> None:
@@ -784,19 +806,26 @@ def _partial_note(leg: dict) -> str:
 def write_report() -> None:
     """Collect whichever leg JSONs exist into RESULTS.md (a wedged TPU
     tunnel can leave one leg missing — report the evidence that exists)."""
-    central = fed = dp = adressa = finetune = bf16 = None
-    if (HERE / "accuracy_central.json").exists():
-        central = json.loads((HERE / "accuracy_central.json").read_text())
-    if (HERE / "accuracy_fed.json").exists():
-        fed = json.loads((HERE / "accuracy_fed.json").read_text())
-    if (HERE / "accuracy_dp.json").exists():
-        dp = json.loads((HERE / "accuracy_dp.json").read_text())
-    if (HERE / "accuracy_adressa.json").exists():
-        adressa = json.loads((HERE / "accuracy_adressa.json").read_text())
-    if (HERE / "accuracy_finetune.json").exists():
-        finetune = json.loads((HERE / "accuracy_finetune.json").read_text())
-    if (HERE / "accuracy_bf16.json").exists():
-        bf16 = json.loads((HERE / "accuracy_bf16.json").read_text())
+    def _load_complete(fname: str):
+        # an artifact flagged "partial" (incremental stamp of a run that
+        # never finished) lacks the leg's summary fields — reporting it
+        # would KeyError mid-report or publish a half-trained comparison
+        path = HERE / fname
+        if not path.exists():
+            return None
+        d = json.loads(path.read_text())
+        if d.get("partial"):
+            print(f"[report] skipping {fname}: partial (run never "
+                  "completed); re-run the leg", file=sys.stderr)
+            return None
+        return d
+
+    central = _load_complete("accuracy_central.json")
+    fed = _load_complete("accuracy_fed.json")
+    dp = _load_complete("accuracy_dp.json")
+    adressa = _load_complete("accuracy_adressa.json")
+    finetune = _load_complete("accuracy_finetune.json")
+    bf16 = _load_complete("accuracy_bf16.json")
     if all(x is None for x in (central, fed, dp, adressa, finetune, bf16)):
         raise SystemExit("no accuracy_*.json found; run the legs first")
 

@@ -64,20 +64,35 @@ def git_dirty_paths(repo: Path | None = None) -> list[str] | None:
 
 
 def write_artifact(path: Path, payload: dict, partial: bool) -> None:
-    """Atomic benchmark-artifact write with the incremental-banking flag.
+    """Atomic benchmark-artifact write with incremental-run staging.
 
-    Benchmark harnesses stamp their artifact after every measured row so a
-    tunnel wedge mid-run keeps completed rows as labeled evidence; the
-    watcher banks a queue item (stops retrying) only when ``"partial"`` is
-    absent. Two disciplines keep that contract kill-safe: ``partial`` is
-    serialized FIRST (a torn tail can then never drop the flag while
-    keeping the provenance block), and the write goes through a temp file
-    + ``os.replace`` so no reader ever sees a half-written JSON.
+    Benchmark harnesses stamp after every measured row so a tunnel wedge
+    mid-run keeps completed rows as labeled evidence. Three disciplines
+    keep that kill-safe AND clobber-safe:
+
+      * ``partial=True`` stamps go to a ``<stem>.inprogress.json`` sidecar
+        — the canonical artifact is replaced only by a COMPLETED run, so a
+        wedged re-run can never destroy previously banked complete
+        evidence;
+      * the ``"partial"`` flag is serialized FIRST (a torn tail can then
+        never drop the flag while keeping the provenance block);
+      * every write goes through a temp file + ``os.replace`` so no reader
+        ever sees a half-written JSON.
+
+    A completing write removes the sidecar. The chip watcher banks a queue
+    item only when the canonical artifact is fresh and carries no
+    ``"partial"`` flag.
     """
+    sidecar = path.with_name(path.name[: -len(".json")] + ".inprogress.json"
+                             if path.name.endswith(".json")
+                             else path.name + ".inprogress")
+    target = sidecar if partial else path
     out = {"partial": True, **payload} if partial else dict(payload)
-    tmp = path.with_name(path.name + ".tmp")
+    tmp = target.with_name(target.name + ".tmp")
     tmp.write_text(json.dumps(out, indent=2))
-    os.replace(tmp, path)
+    os.replace(tmp, target)
+    if not partial:
+        sidecar.unlink(missing_ok=True)
 
 
 def provenance(**extra) -> dict:

@@ -213,3 +213,78 @@ def test_leg_dp_one_round_writes_schema(tmp_path):
             # test artifact so write_report can never publish it as a real
             # DP sweep
             art.unlink(missing_ok=True)
+
+
+def test_leg_dp_partial_flag_lifecycle(monkeypatch, tmp_path):
+    """Each trained row stamps the artifact with "partial": true (a tunnel
+    wedge mid-leg must keep completed rows as labeled evidence the watcher
+    will NOT bank); the completed leg drops the flag."""
+    import accuracy_run as ar
+
+    seen_flags = []
+
+    def fake_train(cfg, data, states, on_round=None):
+        return {"curve": [{"auc": 0.6, "mrr": 0.3, "ndcg5": 0.3,
+                           "ndcg10": 0.4, "round": 0, "train_loss": 1.0}]}
+
+    class _FakeData:
+        train_samples = list(range(800))
+        valid_samples = list(range(100))
+        num_news = 64
+
+    monkeypatch.setattr(ar, "_train", fake_train)
+    monkeypatch.setattr(ar, "HERE", tmp_path)
+    monkeypatch.setattr(ar, "oracle_auc", lambda d, s: 0.77)
+    monkeypatch.setattr(ar, "_small_corpus", lambda: (_FakeData(), None))
+    monkeypatch.setenv("FEDREC_DP_ROWS", "nodp_tuned,dp_eps10")
+
+    art_path = tmp_path / "accuracy_dp_tpu.json"
+
+    # observe each stamped state by wrapping the writer at its source
+    import fedrec_tpu.utils.provenance as prov
+
+    real = prov.write_artifact
+
+    def spy(path, payload, partial):
+        seen_flags.append(partial)
+        real(path, payload, partial)
+
+    monkeypatch.setattr(prov, "write_artifact", spy)
+    ar.leg_dp(rounds=1)
+    # one partial stamp per row, then the completing stamp
+    assert seen_flags == [True, True, False]
+    assert "partial" not in json.loads(art_path.read_text())
+    # partial stamps staged in the sidecar, removed on completion — a
+    # wedged re-run must never clobber banked complete evidence
+    assert not (tmp_path / "accuracy_dp_tpu.inprogress.json").exists()
+
+
+def test_write_report_skips_partial_artifacts(monkeypatch, tmp_path, capsys):
+    """A partial artifact (incremental stamp of a run that never finished)
+    must be excluded from RESULTS.md generation instead of KeyError-ing on
+    its missing summary fields."""
+    import accuracy_run as ar
+
+    # minimal COMPLETE central artifact so the report has something to say
+    (tmp_path / "accuracy_central.json").write_text(json.dumps({
+        "leg": "central", "platform": "cpu", "device": "cpu",
+        "corpus": {"num_news": 1, "train": 1, "valid": 1, "bert_hidden": 8},
+        "oracle_auc": 0.7, "rounds_requested": 1,
+        "config": {"mode": "head", "dtype": "float32", "lr": 1e-3,
+                   "batch": 8},
+        "curve": [{"round": 0, "train_loss": 1.0, "auc": 0.6, "mrr": 0.3,
+                   "ndcg5": 0.3, "ndcg10": 0.4}],
+        "wall_s": 1.0,
+    }))
+    # a PARTIAL bf16 artifact missing final_auc/auc_delta
+    (tmp_path / "accuracy_bf16.json").write_text(json.dumps({
+        "partial": True, "leg": "bf16", "platform": "tpu", "runs": {},
+    }))
+    monkeypatch.setattr(ar, "HERE", tmp_path)
+    fake_repo = tmp_path / "repo"
+    fake_repo.mkdir()
+    monkeypatch.setattr(ar, "REPO", fake_repo)
+    ar.write_report()
+    report = (fake_repo / "RESULTS.md").read_text()
+    assert "## Dtype tolerance" not in report
+    assert "skipping accuracy_bf16.json" in capsys.readouterr().err

@@ -129,19 +129,27 @@ def test_git_provenance_helpers(tmp_path):
     assert git_dirty(bare) is None
 
 
-def test_write_artifact_partial_first_and_atomic(tmp_path):
-    # "partial" must be the FIRST serialized key (a torn tail then cannot
-    # keep the provenance block while dropping the flag) and the write must
-    # leave no temp file behind
+def test_write_artifact_stages_partial_and_completes_atomically(tmp_path):
+    # partial stamps go to the .inprogress sidecar (a wedged re-run must
+    # never clobber banked complete evidence), with "partial" as the FIRST
+    # serialized key (a torn tail then cannot keep the provenance block
+    # while dropping the flag); completion replaces the canonical file,
+    # removes the sidecar, and leaves no temp file behind
     import json
 
     from fedrec_tpu.utils.provenance import write_artifact
 
     p = tmp_path / "art.json"
+    p.write_text(json.dumps({"banked": "complete evidence"}))
+    side = tmp_path / "art.inprogress.json"
+
     write_artifact(p, {"a": 1, "provenance": {"jax_backend": "tpu"}}, True)
-    raw = p.read_text()
+    # canonical untouched; sidecar carries the flagged partial
+    assert json.loads(p.read_text()) == {"banked": "complete evidence"}
+    raw = side.read_text()
     assert raw.index('"partial"') < raw.index('"provenance"')
     assert json.loads(raw)["partial"] is True
+
     write_artifact(p, {"a": 2}, False)
     d = json.loads(p.read_text())
     assert "partial" not in d and d["a"] == 2
