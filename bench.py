@@ -832,6 +832,49 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] scan bonus metric failed: {e}\n")
 
+        # rounds-in-jit: R federated rounds — each S train steps PLUS the
+        # round-end weighted FedAvg sync — compiled into ONE dispatch
+        # (train.step.build_fed_round_scan; equality with the host-driven
+        # round loop pinned in tests/test_scan.py). The reference pays
+        # Python+gloo dispatch per batch AND per round by construction
+        # (Parameter_Averaging_main.py:137-151). A bonus metric: its
+        # failure must not discard the primary numbers.
+        try:
+            from fedrec_tpu.train import (
+                build_fed_round_scan,
+                shard_round_batches,
+            )
+
+            R_r, S_r = 4, 8
+            round_scan = build_fed_round_scan(
+                model, cfg, get_strategy("param_avg"), mesh, mode="joint"
+            )
+            w_rounds = jnp.ones((R_r, 1), jnp.float32)
+
+            def make_round_batch(seed: int, bsz: int, n_clients: int = 1):
+                r = np.random.default_rng(seed)
+                stacked_b = {
+                    "candidates": r.integers(
+                        0, num_news, (R_r, S_r, 1, bsz, C)
+                    ).astype(np.int32),
+                    "history": r.integers(
+                        0, num_news, (R_r, S_r, 1, bsz, H)
+                    ).astype(np.int32),
+                    "labels": np.zeros((R_r, S_r, 1, bsz), np.int32),
+                }
+                return shard_round_batches(mesh, stacked_b, cfg)
+
+            dt_r = measure(
+                B, iters=5,
+                the_step=lambda st, b, t: round_scan(st, b, t, w_rounds),
+                batch_maker=make_round_batch,
+            )
+            out["round_scan_samples_per_sec"] = round(R_r * S_r * B / dt_r, 2)
+            out["round_scan_shape"] = {"rounds": R_r, "steps": S_r, "batch": B}
+            stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] round-scan bonus metric failed: {e}\n")
+
         # decoupled (reference-parity) mode: the text tower leaves the step —
         # news vecs come from a precomputed (N, D) table gather; this is the
         # per-batch cost the reference's epoch structure actually implies.

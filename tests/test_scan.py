@@ -214,3 +214,61 @@ def test_scan_cohorts_gru_compose():
     np.testing.assert_allclose(
         np.stack(loop_losses), np.asarray(ms["mean_loss"]), rtol=1e-6, atol=1e-7
     )
+
+
+@pytest.mark.parametrize("strategy,max_dev", [
+    ("param_avg", 8),  # k=1: the reference's per-epoch FedAvg round loop
+    ("param_avg", 4),  # k=2 cohorts
+    ("grad_avg", 8),   # sync is a no-op -> plain multi-epoch-in-jit
+])
+def test_round_scan_matches_host_round_loop(strategy, max_dev):
+    """Rounds-in-jit == the host-driven (epoch scan + param_sync) loop,
+    including client-subset participation weights at each round end."""
+    from fedrec_tpu.train import (
+        build_fed_round_scan,
+        build_param_sync,
+        shard_round_batches,
+        stack_rounds,
+    )
+
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    mesh = client_mesh(8, max_devices=max_dev)
+    data, batcher, token_states, model, stacked0, _ = make_setup(cfg, seed=0)
+    R, S = 3, 2
+    avail = _collect_batches(batcher, 8, R * S)
+    flat = (avail * ((R * S) // len(avail) + 1))[: R * S]  # tile if short
+    rounds = [flat[r * S:(r + 1) * S] for r in range(R)]
+    # round 1 drops clients 0-2; others are full-participation
+    weights = np.ones((R, 8), np.float32)
+    weights[1, :3] = 0.0
+
+    strat = get_strategy(strategy)
+    step = build_fed_train_step(model, cfg, strat, mesh, mode="joint")
+    sync = build_param_sync(cfg, mesh, strat)
+    st_loop = stacked0
+    loop_losses = []
+    for r in range(R):
+        for b in rounds[r]:
+            st_loop, m = step(st_loop, shard_batch(mesh, b), token_states)
+            loop_losses.append(np.asarray(m["mean_loss"]))
+        st_loop = sync(st_loop, jax.numpy.asarray(weights[r]))
+
+    _, _, _, _, stacked0b, _ = make_setup(cfg, seed=0)
+    round_scan = build_fed_round_scan(model, cfg, strat, mesh, mode="joint")
+    st_rs, ms = round_scan(
+        stacked0b,
+        shard_round_batches(mesh, stack_rounds(rounds), cfg),
+        token_states,
+        jax.numpy.asarray(weights),
+    )
+    # metrics come back (R, S, clients...) == the flat loop order
+    rs_losses = np.asarray(ms["mean_loss"]).reshape(R * S, *np.asarray(
+        loop_losses[0]).shape)
+
+    np.testing.assert_allclose(
+        np.stack(loop_losses), rs_losses, rtol=1e-6, atol=1e-7
+    )
+    for a, b in zip(_leaves(st_loop.user_params), _leaves(st_rs.user_params)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    for a, b in zip(_leaves(st_loop.news_params), _leaves(st_rs.news_params)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
