@@ -216,6 +216,13 @@ def test_scan_cohorts_gru_compose():
     )
 
 
+def _make_rounds(batcher, R, S):
+    """R per-round lists of S batches, tiling the (small) epoch if short."""
+    avail = _collect_batches(batcher, 8, R * S)
+    flat = (avail * ((R * S) // len(avail) + 1))[: R * S]
+    return [flat[r * S:(r + 1) * S] for r in range(R)]
+
+
 @pytest.mark.parametrize("strategy,max_dev", [
     ("param_avg", 8),  # k=1: the reference's per-epoch FedAvg round loop
     ("param_avg", 4),  # k=2 cohorts
@@ -235,9 +242,7 @@ def test_round_scan_matches_host_round_loop(strategy, max_dev):
     mesh = client_mesh(8, max_devices=max_dev)
     data, batcher, token_states, model, stacked0, _ = make_setup(cfg, seed=0)
     R, S = 3, 2
-    avail = _collect_batches(batcher, 8, R * S)
-    flat = (avail * ((R * S) // len(avail) + 1))[: R * S]  # tile if short
-    rounds = [flat[r * S:(r + 1) * S] for r in range(R)]
+    rounds = _make_rounds(batcher, R, S)
     # round 1 drops clients 0-2; others are full-participation
     weights = np.ones((R, 8), np.float32)
     weights[1, :3] = 0.0
@@ -272,3 +277,58 @@ def test_round_scan_matches_host_round_loop(strategy, max_dev):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
     for a, b in zip(_leaves(st_loop.news_params), _leaves(st_rs.news_params)):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_round_scan_gru_cohorts_compose():
+    """Rounds-in-jit composed with the GRU user tower AND k=2 cohorts.
+
+    The host side here is the SCAN-form loop (one epoch scan per round +
+    weighted param_sync) — the same inner math, so the compare is tight
+    (observed bit-exact; asserted at 1e-6/1e-7 to stay robust to
+    compiler-version reassociation across the fused sync boundary).
+    Comparing against the per-STEP loop instead shows a ~1e-4 drift for
+    this combo — XLA compiles the vmap'd GRU recurrence differently inside
+    a scan than standalone, and early Adam steps amplify the reassociation
+    noise; that per-step-vs-scan tolerance is test_scan_cohorts_gru_compose's
+    concern, not the round dimension's."""
+    from fedrec_tpu.train import (
+        build_fed_round_scan,
+        build_param_sync,
+        shard_round_batches,
+        stack_rounds,
+    )
+
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    cfg.model.user_tower = "gru"
+    mesh = client_mesh(8, max_devices=4)  # k=2 cohorts
+    data, batcher, token_states, model, stacked0, _ = make_setup(cfg, seed=0)
+    R, S = 2, 2
+    rounds = _make_rounds(batcher, R, S)
+    weights = np.ones((R, 8), np.float32)
+    # drop the ENTIRE second cohort {4..7} in round 0: the cross-cohort
+    # weighted sync must handle a whole cohort contributing zero weight
+    weights[0, 4:] = 0.0
+
+    strat = get_strategy("param_avg")
+    epoch_scan = build_fed_train_scan(model, cfg, strat, mesh, mode="joint")
+    sync = build_param_sync(cfg, mesh, strat)
+    st_loop = stacked0
+    for r in range(R):
+        st_loop, _ = epoch_scan(
+            st_loop, shard_scan_batches(mesh, stack_batches(rounds[r]), cfg),
+            token_states,
+        )
+        st_loop = sync(st_loop, jax.numpy.asarray(weights[r]))
+
+    _, _, _, _, stacked0b, _ = make_setup(cfg, seed=0)
+    round_scan = build_fed_round_scan(model, cfg, strat, mesh, mode="joint")
+    st_rs, _ = round_scan(
+        stacked0b,
+        shard_round_batches(mesh, stack_rounds(rounds), cfg),
+        token_states,
+        jax.numpy.asarray(weights),
+    )
+    for a, b in zip(_leaves(st_loop.user_params), _leaves(st_rs.user_params)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    for a, b in zip(_leaves(st_loop.news_params), _leaves(st_rs.news_params)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
