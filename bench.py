@@ -189,12 +189,19 @@ def _baseline_ratios(
 
 def _affects_measurement(path: str) -> bool:
     """Paths the bench process actually loads: its own code, the framework,
-    the native engine, and the torch-baseline artifact baked into the
-    headline ratios. ``benchmarks/last_tpu_bench.json`` is the bench's own
-    OUTPUT and deliberately absent — every run dirties it."""
+    the native engine, the torch-baseline artifact baked into the headline
+    ratios, and the dependency pins (a jax/jaxlib bump between
+    measured_commit and HEAD changes the installed runtime even though no
+    loaded .py moved — ADVICE r5). ``benchmarks/last_tpu_bench.json`` is
+    the bench's own OUTPUT and deliberately absent — every run dirties it."""
+    name = path.rsplit("/", 1)[-1]
     return (
-        path in ("bench.py", "benchmarks/baseline_host.json")
+        path in ("bench.py", "benchmarks/baseline_host.json", "pyproject.toml")
         or path.startswith(("fedrec_tpu/", "native/"))
+        # requirements*.txt / *.in pin files — NOT docs named requirements.*
+        or (name.startswith("requirements") and name.endswith((".txt", ".in")))
+        or name.endswith(".lock")           # uv.lock / poetry.lock / *.lock
+        or name == "environment.yml"
     )
 
 

@@ -1,0 +1,234 @@
+"""Serving-subsystem load generator: closed- and open-loop latency/throughput.
+
+Where ``serve_bench.py`` measures the raw jitted scorer, this measures the
+SERVICE — micro-batcher coalescing, fixed-shape padding, store reads, and
+(optionally) two-stage retrieval — under the two canonical load models:
+
+* **closed loop**: ``--clients K`` concurrent users, each submitting its
+  next request the moment the previous response lands.  Measures the
+  system's sustainable throughput and the latency it costs.
+* **open loop**: requests arrive on a Poisson process at ``--rate`` req/s
+  regardless of completions (the honest tail-latency model: a slow system
+  cannot slow its own arrivals down).  Measures p50/p99/p99.9 under a
+  fixed offered load, plus how many responses missed their deadline and
+  how many were shed at admission (backpressure).
+
+Runs fully in-process (service + load in one event loop) so the numbers
+isolate the serving stack from kernel TCP behavior; the artifact is
+provenance-stamped like every other ``benchmarks/*.json``.
+
+Usage:
+  python benchmarks/serve_load.py [--num-news 65000] [--clusters 0]
+      [--clients 32] [--rate 200] [--duration 10] [--out serve_load.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _percentiles(lat_ms: list[float]) -> dict:
+    if not lat_ms:
+        return {"count": 0}
+    a = np.asarray(lat_ms)
+    return {
+        "count": int(a.size),
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "p999_ms": round(float(np.percentile(a, 99.9)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+    }
+
+
+async def closed_loop(service, histories, clients: int, duration_s: float) -> dict:
+    # requests go through ServingService.handle (not the raw batcher): the
+    # measured path is the service path, and the service's OWN latency
+    # metrics populate so the artifact's service_metrics section is real
+    lat: list[float] = []
+    done = errors = 0
+    t_end = time.perf_counter() + duration_s
+
+    async def worker(i: int) -> None:
+        nonlocal done, errors
+        rng = np.random.default_rng(i)
+        while time.perf_counter() < t_end:
+            h = histories[rng.integers(len(histories))]
+            resp = await service.handle({"id": i, "history": h})
+            if "error" in resp:
+                errors += 1
+                continue
+            lat.append(resp["latency_ms"])
+            done += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(i) for i in range(clients)))
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "closed",
+        "clients": clients,
+        "throughput_rps": round(done / wall, 2),
+        "errors": errors,
+        "latency": _percentiles(lat),
+    }
+
+
+async def open_loop(
+    service, histories, rate: float, duration_s: float, deadline_ms: float
+) -> dict:
+    lat: list[float] = []
+    shed = missed = errors = 0
+    tasks: set[asyncio.Task] = set()
+    rng = np.random.default_rng(0)
+
+    async def fire(h) -> None:
+        # through service.handle, like closed_loop — handle() converts
+        # backpressure and scorer failures into error responses, so one bad
+        # request can never lose the whole run's artifact
+        nonlocal shed, missed, errors
+        resp = await service.handle({"history": h, "deadline_ms": deadline_ms})
+        if resp.get("error") == "backpressure":
+            shed += 1
+            return
+        if "error" in resp:
+            errors += 1
+            return
+        lat.append(resp["latency_ms"])
+        if not resp["deadline_met"]:
+            missed += 1
+
+    t0 = time.perf_counter()
+    next_at = t0
+    while (now := time.perf_counter()) < t0 + duration_s:
+        if now < next_at:
+            await asyncio.sleep(next_at - now)
+        next_at += rng.exponential(1.0 / rate)  # Poisson arrivals
+        t = asyncio.ensure_future(fire(histories[rng.integers(len(histories))]))
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "open",
+        "offered_rps": rate,
+        "deadline_ms": deadline_ms,
+        "completed_rps": round(len(lat) / wall, 2),
+        "shed_backpressure": shed,
+        "deadline_missed": missed,
+        "errors": errors,
+        "latency": _percentiles(lat),
+    }
+
+
+def build_service(args):
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serving import EmbeddingStore, ServingService
+
+    cfg = ExperimentConfig()
+    cfg.model.dtype = "float32"
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.standard_normal((args.num_news, cfg.model.news_dim)), jnp.float32
+    )
+    h = args.his_len
+    dummy = jnp.zeros((1, h, cfg.model.news_dim), jnp.float32)
+    user_params = model.init(
+        jax.random.PRNGKey(0), dummy, method=NewsRecommender.encode_user
+    )["params"]["user_encoder"]
+    store = EmbeddingStore()
+    store.publish(table, user_params, source="synthetic")
+    service = ServingService(
+        model, store,
+        history_len=h,
+        top_k=args.top_k,
+        batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
+        flush_ms=args.flush_ms,
+        max_queue=args.max_queue,
+        num_clusters=args.clusters,
+        n_probe=args.n_probe,
+        exact_threshold=args.exact_threshold,
+    )
+    histories = [
+        rng.integers(1, args.num_news, (rng.integers(3, h),)).tolist()
+        for _ in range(256)
+    ]
+    return service, histories
+
+
+async def run(args) -> dict:
+    service, histories = build_service(args)
+    service.warmup()
+    await service.start()
+    rows = {}
+    rows["closed"] = await closed_loop(
+        service, histories, args.clients, args.duration
+    )
+    rows["open"] = await open_loop(
+        service, histories, args.rate, args.duration, args.deadline_ms
+    )
+    rows["service_metrics"] = service.metrics()
+    await service.stop()
+    return rows
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-news", type=int, default=65_000)
+    p.add_argument("--his-len", type=int, default=50)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--batch-sizes", default="1,8,32,128")
+    p.add_argument("--flush-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=1024)
+    p.add_argument("--clusters", type=int, default=0)
+    p.add_argument("--n-probe", type=int, default=8)
+    p.add_argument("--exact-threshold", type=int, default=4096)
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--rate", type=float, default=200.0, help="open-loop req/s")
+    p.add_argument("--deadline-ms", type=float, default=100.0)
+    p.add_argument("--duration", type=float, default=10.0, help="per-mode seconds")
+    p.add_argument("--out", default="serve_load.json")
+    args = p.parse_args()
+
+    import jax
+
+    from fedrec_tpu.utils.provenance import provenance, write_artifact
+
+    rows = asyncio.run(run(args))
+    out = {
+        "metric": "serving_load",
+        "num_news": args.num_news,
+        "his_len": args.his_len,
+        "top_k": args.top_k,
+        "batch_sizes": args.batch_sizes,
+        "flush_ms": args.flush_ms,
+        "clusters": args.clusters,
+        "n_probe": args.n_probe,
+        "backend": jax.default_backend(),
+        **rows,
+        "provenance": provenance(),
+    }
+    write_artifact(Path(__file__).with_name(args.out), out, partial=False)
+    print(f"closed: {rows['closed']['throughput_rps']} rps "
+          f"p99={rows['closed']['latency'].get('p99_ms')}ms | "
+          f"open@{args.rate}rps: p99={rows['open']['latency'].get('p99_ms')}ms "
+          f"shed={rows['open']['shed_backpressure']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

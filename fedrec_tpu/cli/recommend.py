@@ -71,7 +71,6 @@ def main(argv: list[str] | None = None) -> int:
     from fedrec_tpu.data import load_mind_artifacts
     from fedrec_tpu.models import NewsRecommender
     from fedrec_tpu.serve import build_recommend_fn
-    from fedrec_tpu.train.checkpoint import SnapshotManager
     from fedrec_tpu.train.step import encode_all_news, encode_corpus_tokens
 
     cfg = ExperimentConfig()
@@ -100,76 +99,24 @@ def main(argv: list[str] | None = None) -> int:
               "hyperparameters come from defaults + --set and are NOT "
               "verified against the training run", file=sys.stderr)
 
-    # two snapshot formats can coexist in one directory: orbax trees
-    # (fedrec-run) and the coordinator deployment's flax-msgpack globals
-    # ({user, news, round}, no client dim). Serve whichever was WRITTEN
-    # more recently — round counters are per-run and say nothing about
-    # recency across unrelated runs (a 50-round fedrec-run must not shadow
-    # a later 20-round coordinator deployment), so the tie-break is the
-    # artifacts' own mtimes.
-    from fedrec_tpu.train.checkpoint import coordinator_globals
+    # orbax trees (fedrec-run) and coordinator msgpack globals can coexist
+    # in one directory; the shared restore policy (most recently WRITTEN
+    # wins, host arrays, client-0 extraction) lives in
+    # fedrec_tpu.serving.store so the one-shot CLI and the long-lived
+    # server can never restore different checkpoints from the same dir
+    from fedrec_tpu.serving.store import load_checkpoint_params
 
-    snapshots = SnapshotManager(snap_dir)
-    orbax_round = snapshots.latest_round()
-    globals_ = coordinator_globals(snap_dir)
-
-    def _mtime(path: Path) -> float:
-        try:
-            return path.stat().st_mtime
-        except OSError:
-            return 0.0
-
-    orbax_mtime = (
-        _mtime(Path(snap_dir) / str(orbax_round)) if orbax_round is not None else 0.0
-    )
-    global_mtime = _mtime(globals_[-1]) if globals_ else 0.0
-    if orbax_round is not None and globals_:
-        newer = "orbax" if orbax_mtime >= global_mtime else "coordinator"
-        print(f"[recommend] both orbax (round {orbax_round}) and coordinator "
-              f"globals in {snap_dir}; serving the most recently written "
-              f"({newer})", file=sys.stderr)
-
-    if orbax_round is not None and (not globals_ or orbax_mtime >= global_mtime):
-        # template-free restore: serving must not depend on the training
-        # run's client count or mesh — any (N_clients, ...) snapshot serves
-        # anywhere (after param_avg/coordinator aggregation all clients are
-        # identical; client 0 is the convention, Trainer._client0_params)
-        raw = snapshots.restore_raw()
-        snapshots.close()
-        # HOST arrays, not jnp: an orbax restore can carry the TRAINING
-        # run's device placement (e.g. a 4-client mesh), which conflicts
-        # with the serving mesh when build_recommend_fn_sharded spans all
-        # local devices — let the jitted scorer place them instead
-        client0 = jax.tree_util.tree_map(lambda x: np.asarray(x[0]), raw)
-        user_params, news_params = client0["user_params"], client0["news_params"]
-    elif globals_:
-        snapshots.close()
-        from flax import serialization
-
-        # newest first; retry older files if a concurrent retention pass
-        # unlinks one between the glob and the read (writes are atomic)
-        raw = None
-        for cand in reversed(globals_):
-            try:
-                raw = serialization.msgpack_restore(cand.read_bytes())
-                break
-            except FileNotFoundError:
-                continue
-        if raw is None:
-            print(f"[recommend] coordinator globals vanished under {snap_dir}; "
-                  "retry", file=sys.stderr)
-            return 2
-        # host arrays for the same reason as the orbax path above
-        user_params = jax.tree_util.tree_map(np.asarray, raw["user"])
-        news_params = jax.tree_util.tree_map(np.asarray, raw["news"])
-        print(f"[recommend] serving coordinator global round {raw['round']}",
-              file=sys.stderr)
-    else:
-        snapshots.close()
-        print(f"[recommend] no orbax snapshot or coordinator global under "
-              f"{snap_dir} — train first (fedrec-run / fedrec-coordinator) "
-              "or pass --snapshot-dir", file=sys.stderr)
+    try:
+        user_params, news_params, round_, kind = load_checkpoint_params(
+            snap_dir, log=lambda m: print(f"[recommend] {m}", file=sys.stderr)
+        )
+    except FileNotFoundError as e:
+        print(f"[recommend] {e} — train first (fedrec-run / "
+              "fedrec-coordinator) or pass --snapshot-dir", file=sys.stderr)
         return 2
+    print(f"[recommend] serving {kind} snapshot"
+          + (f" (round {round_})" if round_ is not None else ""),
+          file=sys.stderr)
 
     data = load_mind_artifacts(args.data_dir)
     model = NewsRecommender(cfg.model)

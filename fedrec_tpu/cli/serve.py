@@ -1,0 +1,164 @@
+"""Long-lived serving driver: ``fedrec-serve``.
+
+Where ``fedrec-recommend`` is a one-shot batch job (restore -> encode ->
+emit JSONL -> exit), this starts the online subsystem
+(:mod:`fedrec_tpu.serving`): a TCP/JSON-lines server whose embedding
+store can be hot-swapped from new training checkpoints while requests
+are in flight (``{"cmd": "refresh", ...}`` on any connection).
+
+Usage:
+  # real artifacts (reference UserData layout + a training snapshot dir):
+  fedrec-serve --data-dir UserData --snapshot-dir snapshots --port 7607
+
+  # synthetic catalog, no artifacts needed (smoke / load testing):
+  fedrec-serve --synthetic 65000 --port 7607
+
+  # million-item mode: two-stage retrieval kicks in past --exact-threshold
+  fedrec-serve --synthetic 1000000 --clusters 1024 --n-probe 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7607)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--keep-history", action="store_true",
+                   help="allow already-clicked news in responses")
+    # ---- batching
+    p.add_argument("--batch-sizes", default="1,8,32,128",
+                   help="fixed padded batch buckets (comma-separated)")
+    p.add_argument("--flush-ms", type=float, default=2.0,
+                   help="max coalescing wait for the oldest pending request")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="queue-depth backpressure threshold")
+    # ---- retrieval
+    p.add_argument("--clusters", type=int, default=0,
+                   help="k-means coarse clusters (0 = exact full-catalog scoring)")
+    p.add_argument("--n-probe", type=int, default=8)
+    p.add_argument("--exact-threshold", type=int, default=4096,
+                   help="catalogs at/below this size always use exact scoring")
+    # ---- model / data sources
+    p.add_argument("--synthetic", type=int, default=0, metavar="N",
+                   help="serve a random N-item catalog with fresh-init params "
+                        "(no artifacts needed; scores are meaningless)")
+    p.add_argument("--data-dir", default="/root/reference/UserData")
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--token-states", default=None,
+                   help="(N, L, bert_hidden) .npy of cached trunk states")
+    p.add_argument("--metrics-every", type=float, default=30.0,
+                   help="seconds between metric JSON lines on stdout")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="SECTION.KEY=VALUE")
+    return p
+
+
+def _synthetic_service(args, cfg):
+    """Random catalog + fresh-init user params: every serving code path
+    (batching, retrieval, swap) without any training artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serving import EmbeddingStore, ServingService
+
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.standard_normal((args.synthetic, cfg.model.news_dim)), jnp.float32
+    )
+    dummy = jnp.zeros((1, cfg.data.max_his_len, cfg.model.news_dim), jnp.float32)
+    user_params = model.init(
+        jax.random.PRNGKey(0), dummy, method=NewsRecommender.encode_user
+    )["params"]["user_encoder"]
+    store = EmbeddingStore()
+    store.publish(table, user_params, source="synthetic")
+    return _service(args, cfg, model, store, id_map=None)
+
+
+def _checkpoint_service(args, cfg):
+    from fedrec_tpu.data import load_mind_artifacts
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serving.store import EmbeddingStore, publish_from_checkpoint
+
+    snap_dir = args.snapshot_dir or cfg.train.snapshot_dir
+    data = load_mind_artifacts(args.data_dir)
+    token_path = args.token_states or str(Path(args.data_dir) / "token_states.npy")
+    if not Path(token_path).exists():
+        print(f"[serve] ERROR: no token states at {token_path}; export them or "
+              "pass --token-states (or use --synthetic for a smoke catalog)",
+              file=sys.stderr)
+        return None
+    token_states = np.load(token_path)
+    index2nid = {i: n for n, i in data.nid2index.items()}
+    valid = np.zeros(data.num_news, bool)
+    valid[[i for i in index2nid if 0 <= i < data.num_news]] = True
+    model = NewsRecommender(cfg.model)
+    store = EmbeddingStore()
+    gen = publish_from_checkpoint(
+        store, model, snap_dir, token_states, valid_mask=valid,
+        dtype=cfg.model.dtype,
+    )
+    print(f"[serve] generation 0 from {gen.source} round {gen.round}",
+          file=sys.stderr)
+    return _service(args, cfg, model, store, id_map=index2nid)
+
+
+def _service(args, cfg, model, store, id_map):
+    from fedrec_tpu.serving import ServingService
+
+    return ServingService(
+        model,
+        store,
+        history_len=cfg.data.max_his_len,
+        top_k=args.top_k,
+        exclude_history=not args.keep_history,
+        batch_sizes=tuple(int(b) for b in args.batch_sizes.split(",")),
+        flush_ms=args.flush_ms,
+        max_queue=args.max_queue,
+        num_clusters=args.clusters,
+        n_probe=args.n_probe,
+        exact_threshold=args.exact_threshold,
+        id_map=id_map,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.serving import serve_forever
+    from fedrec_tpu.utils.logging import MetricLogger
+
+    cfg = ExperimentConfig()
+    cfg.apply_overrides(args.overrides)
+
+    service = (
+        _synthetic_service(args, cfg) if args.synthetic
+        else _checkpoint_service(args, cfg)
+    )
+    if service is None:
+        return 2
+    service.warmup()  # compile every bucket before accepting traffic
+    logger = MetricLogger()
+    try:
+        asyncio.run(serve_forever(
+            service, host=args.host, port=args.port,
+            metrics_every_s=args.metrics_every, logger=logger,
+        ))
+    except KeyboardInterrupt:
+        print("[serve] interrupted; shutting down", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+"""JAX version compatibility.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+``jax`` top level, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` along the way.  The framework targets the
+new spelling (pyproject pins jax>=0.9) but must still import on older
+installs — a serving host is exactly the place where the runtime can lag
+the dev pin.  Import :data:`shard_map` from here instead of ``jax``:
+call sites keep the modern ``check_vma=...`` kwarg and the shim
+translates when the underlying JAX only knows ``check_rep``.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern spelling (jax >= 0.6)
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -34,6 +34,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# module-local alias, NOT a monkeypatch of jax's namespace: pre-rename jax
+# spells it TPUCompilerParams, and other libraries feature-detect on pltpu
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams", None
+)
+
 _LANE = 128
 _SUBLANE = 8
 _NEG_INF = -1e9
@@ -160,7 +166,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, _LANE), jnp.float32),    # running max
             pltpu.VMEM((block_q, _LANE), jnp.float32),    # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -314,7 +320,7 @@ def _flash_bwd(block_q, block_k, res, g):
             (1, block_q, qp.shape[2]), lambda b, i, j: (b, i, 0)
         ),
         scratch_shapes=[pltpu.VMEM((block_q, qp.shape[2]), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -347,7 +353,7 @@ def _flash_bwd(block_q, block_k, res, g):
             pltpu.VMEM((block_k, dvp_dim), jnp.float32),
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

@@ -24,7 +24,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from fedrec_tpu.compat import shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
@@ -56,8 +58,9 @@ def build_recommend_fn(
     ``history``: (B, H) int32 clicked-news ids, 0-padded like training
     batches; ids outside ``[0, N)`` are ignored by the EXCLUSION mask
     (identically in the dense and sharded scorers) — but the history
-    GATHER that feeds the user encoding still clamps/wraps them per JAX
-    indexing, so garbage ids perturb the user vector. Returns ``ids``
+    GATHER that feeds the user encoding clamps them into range (explicitly,
+    identically in both scorers), so garbage ids still perturb the user
+    vector — deterministically. Returns ``ids``
     (B, k) int32 and ``scores`` (B, k) float32,
     best first, with ``k = min(top_k, N)``. When fewer than ``k`` valid
     items exist (tiny catalog, long history), the tail slots carry id ``-1``
@@ -72,7 +75,11 @@ def build_recommend_fn(
         valid_mask = jnp.asarray(valid_mask, bool)
 
     def recommend(user_params: Any, news_vecs: jnp.ndarray, history: jnp.ndarray):
-        his_vecs = news_vecs[history]  # (B, H, D)
+        # clamp the gather indices explicitly: out-of-range ids otherwise
+        # hit XLA's OOB gather lowering, which differs between the dense
+        # and sharded partitionings (and across XLA versions) — clamping
+        # pins one deterministic degenerate-input behavior for both paths
+        his_vecs = news_vecs[jnp.clip(history, 0, news_vecs.shape[0] - 1)]  # (B, H, D)
         user_vec = model.apply(
             {"params": {"user_encoder": user_params}},
             his_vecs,
@@ -135,8 +142,9 @@ def build_recommend_fn_sharded(
         valid = jnp.pad(valid, (0, pad)) if pad else valid  # pad rows False
         # user encoding is tiny ((B, H, D)); the history gather over the
         # sharded table is a global-semantics take — XLA inserts the
-        # collective pieces it needs
-        his_vecs = news_vecs[history]
+        # collective pieces it needs. Indices clamped exactly like the
+        # dense path, so degenerate ids cannot diverge across paths
+        his_vecs = news_vecs[jnp.clip(history, 0, n - 1)]
         user_vec = model.apply(
             {"params": {"user_encoder": user_params}},
             his_vecs,

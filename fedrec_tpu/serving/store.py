@@ -1,0 +1,222 @@
+"""Versioned news-embedding store with atomic hot-swap.
+
+A federated trainer produces a new global model every round; a long-lived
+server must pick those up WITHOUT restarting and WITHOUT any request ever
+observing a half-updated state (user params from round R scoring news
+vectors from round R+1 would silently corrupt every score).
+
+The store holds immutable :class:`Generation` snapshots.  ``publish``
+builds the complete new generation first and then swaps it in with ONE
+reference assignment — atomic under the GIL, and doubly so under the
+single-threaded asyncio server.  Readers call ``current()`` exactly once
+per batch and score the whole batch against that snapshot, so a swap
+mid-stream only affects which generation LATER batches see, never the
+internal consistency of an in-flight one.
+
+Staleness is first-class: every generation records the federated round it
+came from (when known) and its publish time, and ``metrics()`` exposes
+``generation`` / ``swap_count`` / ``staleness_sec`` so an operator can
+alarm on a server that stopped tracking the trainer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+class EmptyStoreError(RuntimeError):
+    """``current()`` before any generation was published."""
+
+
+@dataclass(frozen=True)
+class Generation:
+    """One immutable serving snapshot.  All fields are set at build time;
+    requests served from a generation see exactly these arrays."""
+
+    generation: int
+    news_vecs: Any                    # (N, D) news-vector table
+    user_params: Any                  # user-tower param tree
+    valid_mask: np.ndarray | None     # (N,) bool; False rows never served
+    round: int | None                 # federated round, when known
+    source: str                       # "synthetic" | "checkpoint" | ...
+    published_at: float
+
+    @property
+    def num_news(self) -> int:
+        return int(self.news_vecs.shape[0])
+
+
+class EmbeddingStore:
+    """Holds the current :class:`Generation` and swap bookkeeping.
+
+    Thread-safe by construction for readers (one attribute read); writers
+    serialize on a lock only to keep ``generation`` numbers and
+    ``swap_count`` consistent if two publishers ever race.
+    """
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._gen: Generation | None = None
+        self._swap_count = 0
+
+    # ------------------------------------------------------------ readers
+    def current(self) -> Generation:
+        gen = self._gen
+        if gen is None:
+            raise EmptyStoreError("no generation published yet")
+        return gen
+
+    @property
+    def generation(self) -> int:
+        return self.current().generation
+
+    @property
+    def swap_count(self) -> int:
+        return self._swap_count
+
+    def metrics(self) -> dict:
+        gen = self._gen
+        if gen is None:
+            return {"generation": None, "swap_count": self._swap_count}
+        return {
+            "generation": gen.generation,
+            "swap_count": self._swap_count,
+            "round": gen.round,
+            "source": gen.source,
+            "num_news": gen.num_news,
+            "staleness_sec": round(self._clock() - gen.published_at, 3),
+        }
+
+    # ------------------------------------------------------------ writers
+    def publish(
+        self,
+        news_vecs,
+        user_params,
+        valid_mask: np.ndarray | None = None,
+        round: int | None = None,
+        source: str = "manual",
+    ) -> Generation:
+        """Build the full new generation, then swap it in atomically.
+        The first publish is generation 0 and does not count as a swap."""
+        with self._lock:
+            prev = self._gen
+            gen = Generation(
+                generation=0 if prev is None else prev.generation + 1,
+                news_vecs=news_vecs,
+                user_params=user_params,
+                valid_mask=valid_mask,
+                round=round,
+                source=source,
+                published_at=self._clock(),
+            )
+            self._gen = gen  # the one atomic publish point
+            if prev is not None:
+                self._swap_count += 1
+            return gen
+
+
+def load_checkpoint_params(
+    snap_dir: str | Path, log=None
+) -> tuple[Any, Any, int | None, str]:
+    """Restore ``(user_params, news_params, round, kind)`` from whichever
+    snapshot format in ``snap_dir`` was written most recently.
+
+    THE restore policy, shared by the one-shot CLI
+    (:mod:`fedrec_tpu.cli.recommend`) and the online server: orbax trees
+    (fedrec-run) and coordinator flax-msgpack globals can coexist in one
+    directory, and round counters are per-run (a 50-round fedrec-run must
+    not shadow a later 20-round coordinator deployment), so the tie-break
+    is the artifacts' own mtimes.  Params come back as HOST arrays so the
+    serving jit places them itself (an orbax restore can carry the
+    training run's device placement).  ``log`` (optional callable) gets
+    operator-facing diagnostics like the both-formats-present notice.
+    """
+    import jax
+
+    from fedrec_tpu.train.checkpoint import SnapshotManager, coordinator_globals
+
+    snap_dir = Path(snap_dir)
+    snapshots = SnapshotManager(snap_dir)
+    orbax_round = snapshots.latest_round()
+    globals_ = coordinator_globals(snap_dir)
+
+    def _mtime(path: Path) -> float:
+        try:
+            return path.stat().st_mtime
+        except OSError:
+            return 0.0
+
+    orbax_mtime = (
+        _mtime(snap_dir / str(orbax_round)) if orbax_round is not None else 0.0
+    )
+    global_mtime = _mtime(globals_[-1]) if globals_ else 0.0
+    if log is not None and orbax_round is not None and globals_:
+        newer = "orbax" if orbax_mtime >= global_mtime else "coordinator"
+        log(f"both orbax (round {orbax_round}) and coordinator globals in "
+            f"{snap_dir}; serving the most recently written ({newer})")
+
+    if orbax_round is not None and (not globals_ or orbax_mtime >= global_mtime):
+        raw = snapshots.restore_raw()
+        snapshots.close()
+        # client 0 is the post-aggregation convention (all clients identical
+        # after param_avg/coordinator sync — Trainer._client0_params)
+        client0 = jax.tree_util.tree_map(lambda x: np.asarray(x[0]), raw)
+        return client0["user_params"], client0["news_params"], orbax_round, "orbax"
+    if globals_:
+        snapshots.close()
+        from flax import serialization
+
+        raw = None
+        for cand in reversed(globals_):
+            try:
+                raw = serialization.msgpack_restore(cand.read_bytes())
+                break
+            except FileNotFoundError:
+                continue  # concurrent retention pass; writes are atomic
+        if raw is None:
+            raise FileNotFoundError(f"coordinator globals vanished under {snap_dir}")
+        user = jax.tree_util.tree_map(np.asarray, raw["user"])
+        news = jax.tree_util.tree_map(np.asarray, raw["news"])
+        return user, news, int(raw["round"]), "coordinator"
+    snapshots.close()
+    raise FileNotFoundError(
+        f"no orbax snapshot or coordinator global under {snap_dir}"
+    )
+
+
+def publish_from_checkpoint(
+    store: EmbeddingStore,
+    model,
+    snap_dir: str | Path,
+    token_states: np.ndarray,
+    valid_mask: np.ndarray | None = None,
+    dtype: str = "float32",
+) -> Generation:
+    """Refresh flow: checkpoint -> ``encode_all_news`` -> atomic publish.
+
+    ``token_states`` is the (N, L, bert_hidden) cached-trunk table the
+    table/head modes serve from (the finetune path would re-encode tokens;
+    the server keeps that out of the hot path by requiring states here).
+    """
+    import jax.numpy as jnp
+
+    from fedrec_tpu.train.step import encode_all_news
+
+    user_params, news_params, round_, kind = load_checkpoint_params(snap_dir)
+    table = encode_all_news(
+        model, news_params, jnp.asarray(token_states, jnp.dtype(dtype))
+    )
+    return store.publish(
+        table,
+        user_params,
+        valid_mask=valid_mask,
+        round=round_,
+        source=f"checkpoint:{kind}",
+    )

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from fedrec_tpu.compat import shard_map
 
 from fedrec_tpu.config import ExperimentConfig
 from fedrec_tpu.eval.metrics import ranking_metrics_batch

@@ -436,7 +436,7 @@ class Trainer:
         if self._adopt_fn is None:
             from functools import partial
 
-            from jax import shard_map
+            from fedrec_tpu.compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             axis = self.cfg.fed.mesh_axis

@@ -87,7 +87,12 @@ def write_artifact(path: Path, payload: dict, partial: bool) -> None:
                              if path.name.endswith(".json")
                              else path.name + ".inprogress")
     target = sidecar if partial else path
-    out = {"partial": True, **payload} if partial else dict(payload)
+    # strip any incoming "partial" key first: a replayed payload (e.g. a
+    # harness re-stamping a previously banked dict) could otherwise carry
+    # partial=False into the spread and silently mark a sidecar complete —
+    # the flag belongs to THIS write's `partial` argument alone
+    payload = {k: v for k, v in payload.items() if k != "partial"}
+    out = {"partial": True, **payload} if partial else payload
     tmp = target.with_name(target.name + ".tmp")
     tmp.write_text(json.dumps(out, indent=2))
     os.replace(tmp, target)

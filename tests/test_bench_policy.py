@@ -343,3 +343,27 @@ def test_git_dirty_paths_records_staged_rename_source(tmp_path):
     _mini_repo(tmp_path)
     _git(tmp_path, "mv", "fedrec_tpu/a.py", "notes.md")
     assert "fedrec_tpu/a.py" in git_dirty_paths(tmp_path)
+
+
+def test_affects_measurement_includes_dependency_pins():
+    """A jax pin bump in pyproject.toml (or any lock/requirements file)
+    changes the installed runtime without touching a loaded .py — the
+    staleness verdict must treat it as measurement-affecting (ADVICE r5)."""
+    from bench import _affects_measurement
+
+    for p in (
+        "pyproject.toml",
+        "requirements.txt",
+        "requirements-dev.txt",
+        "uv.lock",
+        "poetry.lock",
+        "environment.yml",
+    ):
+        assert _affects_measurement(p), p
+    # the classic loading paths still hold, and docs/artifacts still don't —
+    # including docs that merely START with "requirements"
+    assert _affects_measurement("bench.py")
+    assert _affects_measurement("fedrec_tpu/train/step.py")
+    assert not _affects_measurement("README.md")
+    assert not _affects_measurement("docs/requirements.md")
+    assert not _affects_measurement("benchmarks/last_tpu_bench.json")

@@ -12,10 +12,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from fedrec_tpu.compat import shard_map
 
 from fedrec_tpu.parallel.ring import (
     ring_attention,

@@ -1,0 +1,210 @@
+"""Micro-batcher unit tests: fixed shape buckets, deadline-driven flush,
+backpressure, and honest ``deadline_met`` flags — all against a fake
+scorer, so they pin the coalescing logic itself (no JAX, sub-second)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.serving import Backpressure, MicroBatcher
+
+H = 6
+K = 3
+
+
+def make_scorer(seen_shapes, delay_s=0.0, generation=7):
+    """Fake scorer: top-k ids are the first K history ids (row-identifying,
+    so result routing is checkable), scores are the row index."""
+
+    def score(hist):
+        if delay_s:
+            time.sleep(delay_s)
+        seen_shapes.append(hist.shape)
+        b = hist.shape[0]
+        ids = hist[:, :K].astype(np.int32)
+        scores = np.tile(np.arange(b, dtype=np.float32)[:, None], (1, K))
+        return ids, scores, generation
+
+    return score
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_concurrent_submits_coalesce_into_one_bucket_shape():
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes), history_len=H,
+                         batch_sizes=(1, 8, 32), flush_ms=5.0)
+        await b.start()
+        results = await asyncio.gather(
+            *(b.submit([i + 1, i + 2, i + 3]) for i in range(5))
+        )
+        await b.stop()
+        return results
+
+    results = run(main())
+    # 5 concurrent submits ride ONE padded batch of the smallest bucket >= 5
+    assert shapes == [(8, H)]
+    for i, r in enumerate(results):
+        # each caller got ITS row back (ids echo its history head)
+        np.testing.assert_array_equal(r.ids, [i + 1, i + 2, i + 3])
+        assert r.generation == 7
+        assert r.batch_size == 8 and r.occupancy == pytest.approx(5 / 8)
+        assert r.deadline_met  # no deadline given -> trivially met
+
+
+def test_only_registered_shapes_ever_reach_the_scorer():
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes), history_len=H,
+                         batch_sizes=(1, 4, 16), flush_ms=1.0)
+        await b.start()
+        for wave in (1, 3, 9, 16, 23):
+            await asyncio.gather(
+                *(b.submit([i + 1]) for i in range(wave))
+            )
+        await b.stop()
+
+    run(main())
+    assert {s[0] for s in shapes} <= {1, 4, 16}
+    assert all(s[1] == H for s in shapes)
+
+
+def test_history_normalized_to_fixed_length():
+    shapes, got = [], {}
+
+    def score(hist):
+        shapes.append(hist.shape)
+        got["rows"] = hist.copy()
+        b = hist.shape[0]
+        return (hist[:, :K].astype(np.int32),
+                np.zeros((b, K), np.float32), 0)
+
+    async def main():
+        b = MicroBatcher(score, history_len=H, batch_sizes=(2,), flush_ms=1.0)
+        await b.start()
+        await asyncio.gather(
+            b.submit(list(range(1, 20))),   # longer than H: keep the tail
+            b.submit([5]),                  # shorter: zero-pad
+        )
+        await b.stop()
+
+    run(main())
+    rows = got["rows"]
+    np.testing.assert_array_equal(rows[0], list(range(14, 20)))  # last H clicks
+    np.testing.assert_array_equal(rows[1], [5, 0, 0, 0, 0, 0])
+
+
+def test_deadline_forces_early_flush():
+    """A request with little slack must not sit out a long coalescing
+    window: flush_ms=2000 but a 100 ms deadline (50 ms safety margin) ->
+    served in well under the window, with time to spare."""
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes), history_len=H,
+                         batch_sizes=(1, 8), flush_ms=2000.0,
+                         deadline_margin_ms=50.0)
+        await b.start()
+        t0 = time.monotonic()
+        r = await b.submit([1, 2, 3], deadline_ms=100.0)
+        waited = time.monotonic() - t0
+        await b.stop()
+        return r, waited
+
+    r, waited = run(main())
+    assert r.deadline_met
+    assert waited < 1.0  # deadline-driven, not the 2 s window
+
+
+def test_missed_deadline_reported_honestly():
+    """Scorer slower than the request's deadline -> the response says so
+    (deadline_met=False) and the miss counter advances."""
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes, delay_s=0.08), history_len=H,
+                         batch_sizes=(1,), flush_ms=1.0)
+        await b.start()
+        r = await b.submit([1, 2, 3], deadline_ms=10.0)
+        m = b.metrics()
+        await b.stop()
+        return r, m
+
+    r, m = run(main())
+    assert not r.deadline_met
+    assert m["deadline_missed"] == 1 and m["served"] == 1
+
+
+def test_backpressure_rejects_at_admission():
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes), history_len=H,
+                         batch_sizes=(1, 4), flush_ms=50.0, max_queue=4)
+        await b.start()
+        out = await asyncio.gather(
+            *(b.submit([1]) for i in range(10)), return_exceptions=True
+        )
+        m = b.metrics()
+        await b.stop()
+        return out, m
+
+    out, m = run(main())
+    rejected = [o for o in out if isinstance(o, Backpressure)]
+    served = [o for o in out if not isinstance(o, Exception)]
+    # the queue admits max_queue requests; the overflow fails FAST with
+    # Backpressure instead of queuing into guaranteed deadline misses
+    assert len(rejected) >= 1 and len(served) >= 4
+    assert len(rejected) + len(served) == 10
+    assert m["rejected"] == len(rejected)
+
+
+def test_scorer_exception_fails_the_batch_not_the_server():
+    calls = {"n": 0}
+
+    def score(hist):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        b = hist.shape[0]
+        return np.zeros((b, K), np.int32), np.zeros((b, K), np.float32), 0
+
+    async def main():
+        b = MicroBatcher(score, history_len=H, batch_sizes=(1,), flush_ms=1.0)
+        await b.start()
+        with pytest.raises(RuntimeError, match="boom"):
+            await b.submit([1])
+        r = await b.submit([2])  # the batcher survived the failed batch
+        await b.stop()
+        return r
+
+    assert run(main()).generation == 0
+
+
+def test_metrics_track_occupancy_and_batches():
+    shapes = []
+
+    async def main():
+        b = MicroBatcher(make_scorer(shapes), history_len=H,
+                         batch_sizes=(1, 8), flush_ms=2.0)
+        await b.start()
+        await asyncio.gather(*(b.submit([1]) for _ in range(8)))
+        await b.submit([2])
+        m = b.metrics()
+        await b.stop()
+        return m
+
+    m = run(main())
+    assert m["served"] == 9
+    assert m["batches"] == 2
+    assert m["batches_by_size"][8] == 1 and m["batches_by_size"][1] == 1
+    assert m["mean_occupancy"] == pytest.approx(1.0)

@@ -1,5 +1,10 @@
 """Trainer integration tests: full rounds, resume-from-snapshot equivalence,
 and the multi-host coordinator over two real processes (CPU).
+
+Module-marked ``slow``: these are the multi-round / multi-process
+integration drives the marker exists for (~12 min on a 1-core CI host —
+they alone would blow the tier-1 time budget). Iterate with
+``-m 'not slow'``; CI runs everything.
 """
 
 import os
@@ -13,6 +18,8 @@ import numpy as np
 import pytest
 
 from fedrec_tpu.hostenv import cpu_host_env
+
+pytestmark = pytest.mark.slow
 
 REPO = str(Path(__file__).resolve().parents[1])
 

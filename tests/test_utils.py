@@ -154,3 +154,27 @@ def test_write_artifact_stages_partial_and_completes_atomically(tmp_path):
     d = json.loads(p.read_text())
     assert "partial" not in d and d["a"] == 2
     assert list(tmp_path.iterdir()) == [p]
+
+
+def test_write_artifact_strips_replayed_partial_key(tmp_path):
+    """A replayed payload already carrying a 'partial' key (e.g. a harness
+    re-stamping a previously banked dict) must not override THIS write's
+    flag: partial=False in the payload cannot mark a sidecar complete, and
+    a stale partial=True cannot linger in a completing write (ADVICE r5)."""
+    import json
+
+    from fedrec_tpu.utils.provenance import write_artifact
+
+    p = tmp_path / "art.json"
+    side = tmp_path / "art.inprogress.json"
+
+    # replayed complete payload, staged as partial: the sidecar must read
+    # partial=True, serialized first, regardless of the stowaway key
+    write_artifact(p, {"partial": False, "a": 1, "provenance": {}}, True)
+    raw = side.read_text()
+    assert json.loads(raw)["partial"] is True
+    assert raw.index('"partial"') < raw.index('"provenance"')
+
+    # replayed partial payload, completing write: no partial flag survives
+    write_artifact(p, {"partial": True, "a": 2}, False)
+    assert json.loads(p.read_text()) == {"a": 2}
