@@ -1,0 +1,11 @@
+# Convenience targets. The native C++ data engine has its own Makefile
+# (native/Makefile); this one is for repo-level workflows.
+
+.PHONY: t1 native
+
+# tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
+t1:
+	@bash scripts/t1.sh
+
+native:
+	$(MAKE) -C native

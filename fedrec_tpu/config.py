@@ -50,6 +50,27 @@ class DataConfig:
     # stays <= cap; the step emits a `unique_overflow` metric (count of
     # clients whose batch overflowed — results invalid if ever nonzero).
     unique_news_cap: int = 0
+    # per-B bucketed cap policy: "64:2560,256:4096" means per-client batches
+    # up to B=64 cap at 2,560 unique slots, up to B=256 at 4,096; batches
+    # larger than every bucket run uncapped (exact). A batch's dedup bound
+    # scales with B, so one global constant either over-caps small batches
+    # or under-caps large ones (a 2,560 cap overflows every B>=128 batch).
+    # Empty = use the global unique_news_cap. Resolved at trace time per
+    # compiled batch shape (train.step.resolve_unique_cap).
+    unique_news_cap_buckets: str = ""
+    # tile the unique token-state gather + text encode in lax.map chunks of
+    # this many rows, with the chunk body rematerialized in backward: the
+    # (unique, L, bert_hidden) gather result is never materialized in HBM
+    # beyond one chunk (peak activation memory drops from O(unique*L*Dh) to
+    # O(chunk*L*Dh)), at the price of re-gathering in backward. Exact same
+    # math (row-wise encode). 0 = off; only bites when unique slots > chunk.
+    gather_chunk: int = 0
+    # bounded host-side prefetch: build batch t+1 on a producer thread while
+    # step t runs on device, keeping the dispatch queue non-empty across an
+    # epoch. Value = queue depth (2 = classic double buffering); 0 = off.
+    # Batch order and contents are identical with prefetch on or off
+    # (tests/test_prefetch.py).
+    prefetch_batches: int = 0
 
 
 @dataclass
@@ -215,6 +236,22 @@ class TrainConfig:
     # (tests/test_scan.py). Chains compile for this one static length; a
     # short epoch tail falls back to per-batch dispatch.
     scan_steps: int = 1
+    # rounds-in-jit: execute whole federated ROUNDS (all local epochs + the
+    # round-end param sync) in compiled chunks of up to this many rounds via
+    # train.step.build_fed_round_scan — one XLA dispatch per chunk instead
+    # of one per batch. Chunks always break at eval/save cadence boundaries,
+    # so checkpoint and evaluation behavior is byte-identical to the
+    # host-driven loop (and so is the trajectory — tests/test_scan.py).
+    # Requires joint/finetune mode, no server optimizer (FedOpt steps are
+    # host-side by design). 1 = host-driven rounds (default).
+    rounds_per_scan: int = 1
+    # donate the batch buffers to the compiled step/scan programs: the
+    # (steps, clients, B, ...) stacks of a round chunk are hundreds of MB at
+    # large B, and donation lets XLA reclaim them as scratch once consumed.
+    # Safe in the Trainer (every dispatch device_puts fresh arrays); leave
+    # False when driving the step builders directly with reused batches
+    # (bench.py's chain timer re-dispatches the same 8 batches).
+    donate_batch: bool = False
     # keep a separate best-validation-AUC snapshot under
     # <snapshot_dir>/best (full snapshot dir incl. config.json, so
     # `fedrec-recommend --snapshot-dir .../best` serves the best round

@@ -23,6 +23,7 @@ from fedrec_tpu.data.native_batcher import (
     NativeTrainBatcher,
     is_available as native_batcher_available,
 )
+from fedrec_tpu.data.prefetch import Prefetcher, maybe_prefetch
 from fedrec_tpu.data.preprocess import (
     build_news_index,
     parse_behaviors_tsv,
@@ -42,7 +43,9 @@ __all__ = [
     "IndexedSamples",
     "MindData",
     "NativeTrainBatcher",
+    "Prefetcher",
     "TrainBatcher",
+    "maybe_prefetch",
     "native_batcher_available",
     "WordPieceTokenizer",
     "build_news_index",

@@ -16,6 +16,11 @@ All shapes are static so XLA compiles the step exactly once. Per-epoch
 negative re-sampling matches the reference's ``newsample`` call inside
 ``__getitem__`` (fresh negatives every epoch).
 
+Both this batcher and the native C++ one (``native_batcher``) compose with
+the bounded host prefetcher (``fedrec_tpu.data.prefetch``,
+``data.prefetch_batches``): the Trainer iterates epochs through it so batch
+t+1 assembles on a producer thread while step t runs on device.
+
 Divergence (ledger): histories longer than ``max_his_len`` are truncated to
 the most recent ``max_his_len`` clicks. The reference's pad expression
 ``his + [0]*(max_his_len - len(his))`` silently produces ragged rows for long

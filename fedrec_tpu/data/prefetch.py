@@ -1,0 +1,145 @@
+"""Bounded double-buffered host prefetch for the training input pipeline.
+
+The train loop's host work — epoch shuffling, negative sampling, batch
+packing (``TrainBatcher``/``NativeTrainBatcher``), and optionally the
+host→device ``device_put`` — runs serially with the device step when the
+loop is written naively: the device sits idle for the whole batch-build
+time between dispatches (the "dispatch gap" row in
+``benchmarks/step_profile.py``). :class:`Prefetcher` moves that work onto
+a producer thread with a BOUNDED handoff queue, so batch t+1 is built
+while step t runs; the bound (``data.prefetch_batches``, 2 = classic
+double buffering) keeps host memory flat instead of racing ahead of the
+device by a whole epoch.
+
+Guarantees (pinned in ``tests/test_prefetch.py``):
+
+  * **Determinism** — one producer thread consumes the source iterator in
+    order into a FIFO queue: the consumer sees exactly the batches, in
+    exactly the order, the bare iterator would yield. Prefetch is a
+    scheduling change, never a data change.
+  * **Bounded depth** — the producer blocks once ``depth`` items are
+    queued; a slow consumer can never make the producer buffer the epoch.
+  * **Clean shutdown** — a producer-side exception is re-raised in the
+    consumer at the position the failed item would have occupied (not
+    swallowed, not deferred to join); closing mid-epoch (``close()``,
+    ``with``, or generator ``.close()``) unblocks and joins the producer
+    thread without leaking it.
+
+The producer holds no JAX state; when a ``transform`` is given (e.g. the
+Trainer's dict packaging) it runs on the producer thread too, off the
+dispatch path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+
+class _Stop:
+    """Sentinel: source iterator exhausted."""
+
+
+class _Raised:
+    """Sentinel: producer raised; carries the exception to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Prefetcher:
+    """Iterate ``source`` through a bounded background queue.
+
+    ``depth``: max items built ahead of the consumer (>= 1).
+    ``transform``: optional per-item callable applied on the producer
+    thread (host-side packaging/transfer work to overlap with the step).
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        depth: int,
+        transform: Callable[[Any], Any] | None = None,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._source = iter(source)
+        self._transform = transform
+        self._thread = threading.Thread(
+            target=self._produce, name="fedrec-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _put(self, item: Any) -> bool:
+        """Blocking put that stays responsive to close(): returns False when
+        the consumer has gone away (item dropped, producer should exit)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._transform is not None:
+                    item = self._transform(item)
+                if not self._put(item):
+                    return
+                if self._stop.is_set():
+                    return
+            self._put(_Stop)
+        except BaseException as e:  # noqa: BLE001 — relayed, not swallowed
+            self._put(_Raised(e))
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self) -> Iterator:
+        try:
+            while True:
+                item = self._q.get()
+                if item is _Stop:
+                    return
+                if isinstance(item, _Raised):
+                    raise item.exc
+                yield item
+        finally:
+            # reached on StopIteration, consumer break, generator .close(),
+            # and consumer-side exceptions alike
+            self.close()
+
+    def close(self) -> None:
+        """Stop the producer and join its thread; idempotent."""
+        self._stop.set()
+        # unblock a producer stuck in put() on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+
+def maybe_prefetch(
+    source: Iterable,
+    depth: int,
+    transform: Callable[[Any], Any] | None = None,
+) -> Iterable:
+    """``Prefetcher`` when ``depth`` > 0, else the bare iterable (with
+    ``transform`` applied inline, so callers get one code path)."""
+    if depth > 0:
+        return Prefetcher(source, depth, transform)
+    if transform is None:
+        return source
+    return (transform(item) for item in source)

@@ -136,6 +136,100 @@ def _cohort_call(local_fn: Callable, k: int, n_args_mapped: int, *args):
     return jax.vmap(local_fn, in_axes=in_axes, axis_name=LOCAL_AXIS)(*args)
 
 
+def parse_cap_buckets(spec: str) -> list[tuple[int, int]]:
+    """Parse ``data.unique_news_cap_buckets`` ("64:2560,256:4096") into a
+    B-ascending list of (max_batch, cap) pairs. Raises on malformed entries
+    so a typo'd policy fails at build time, not silently uncapped."""
+    buckets = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        try:
+            b_s, cap_s = item.split(":")
+            b, cap = int(b_s), int(cap_s)
+        except ValueError:
+            raise ValueError(
+                f"data.unique_news_cap_buckets entry {item!r} is not "
+                "'<max_batch>:<cap>' (e.g. '64:2560,256:4096')"
+            ) from None
+        if b <= 0 or cap <= 0:
+            raise ValueError(
+                f"data.unique_news_cap_buckets entry {item!r}: both the "
+                "batch bound and the cap must be positive"
+            )
+        buckets.append((b, cap))
+    bounds = [b for b, _ in buckets]
+    if len(set(bounds)) != len(bounds):
+        raise ValueError(
+            f"data.unique_news_cap_buckets has duplicate batch bounds "
+            f"({spec!r}); each bound may appear once"
+        )
+    return sorted(buckets)
+
+
+def resolve_unique_cap(cfg: ExperimentConfig, batch_size: int) -> int:
+    """The unique-news cap for one compiled per-client batch size.
+
+    With ``data.unique_news_cap_buckets`` set, picks the cap of the smallest
+    bucket whose batch bound covers ``batch_size``; batches larger than
+    every bucket run uncapped (0 = exact worst-case bound) — a fixed global
+    cap either over-caps small batches or silently overflows large ones
+    (the flagship 2,560 cap overflows every B>=128 batch against the 4,096
+    bench corpus). Without buckets, the global ``data.unique_news_cap``.
+    Called at trace time, so each compiled batch shape gets its own bound.
+    """
+    buckets = parse_cap_buckets(cfg.data.unique_news_cap_buckets)
+    if buckets:
+        for b, cap in buckets:
+            if batch_size <= b:
+                return cap
+        return 0
+    return cfg.data.unique_news_cap
+
+
+def _encode_gathered(
+    model: NewsRecommender,
+    news_params: Any,
+    token_states: jnp.ndarray,
+    uniq: jnp.ndarray,
+    chunk: int = 0,
+) -> jnp.ndarray:
+    """Gather unique token-state rows and run the text head over them.
+
+    The gather result is ``stop_gradient``-ed (the trunk is frozen: no
+    cotangent may ever flow into the (N, L, Dh) table, and saying so lets
+    XLA drop the zero-cotangent scatter a differentiated gather would
+    imply) and tagged ``checkpoint_name("token_gather")`` so remat policies
+    can address it.
+
+    ``chunk`` (``data.gather_chunk``): tile the gather+encode in
+    ``lax.map`` chunks with the chunk body rematerialized in backward —
+    the (unique, L, Dh) gather result then never occupies HBM beyond one
+    chunk (forward residual AND backward), at the price of re-gathering
+    per tile in the backward pass. Row-wise encode, so tiling is exact.
+    """
+    from jax.ad_checkpoint import checkpoint_name
+
+    def encode(ids):
+        states = checkpoint_name(
+            lax.stop_gradient(token_states[ids]), "token_gather"
+        )
+        return model.apply(
+            {"params": {"text_head": news_params}},
+            states,
+            method=NewsRecommender.encode_news,
+        )
+
+    u = uniq.shape[0]
+    if not chunk or u <= chunk:
+        return encode(uniq)
+    pad = (-u) % chunk
+    tiles = jnp.pad(uniq, (0, pad)).reshape(-1, chunk)
+    vecs = lax.map(jax.checkpoint(encode), tiles)  # (tiles, chunk, D)
+    return vecs.reshape(-1, vecs.shape[-1])[:u]
+
+
 def _batch_news_vecs(
     model: NewsRecommender,
     news_params: Any,
@@ -143,16 +237,19 @@ def _batch_news_vecs(
     candidates: jnp.ndarray,
     history: jnp.ndarray,
     cap: int = 0,
+    chunk: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Encode the batch's unique news once; gather into cand/history slots.
 
     ``token_states``: (N_news, L, bert_hidden) HBM-resident feature table.
     Returns cand_vecs (B, C, D) and his_vecs (B, H, D).
 
-    ``cap`` (``data.unique_news_cap``): static bound on the unique slots
-    actually encoded — the worst case B*(C+H) wastes text-tower FLOPs on
+    ``cap`` (``data.unique_news_cap`` / the bucketed policy resolved by
+    :func:`resolve_unique_cap`): static bound on the unique slots actually
+    encoded — the worst case B*(C+H) wastes text-tower FLOPs on
     duplicate/padding rows. Exact while distinct ids <= cap; callers must
-    surface :func:`unique_overflow` when setting it.
+    surface :func:`unique_overflow` when setting it. ``chunk``: see
+    :func:`_encode_gathered`.
     """
     b, c = candidates.shape
     h = history.shape[1]
@@ -164,12 +261,7 @@ def _batch_news_vecs(
     uniq, inv = jnp.unique(
         ids, size=size, fill_value=0, return_inverse=True
     )
-    states = token_states[uniq]  # (size, L, bert_hidden)
-    vecs = model.apply(
-        {"params": {"text_head": news_params}},
-        states,
-        method=NewsRecommender.encode_news,
-    )  # (size, D)
+    vecs = _encode_gathered(model, news_params, token_states, uniq, chunk)
     flat = vecs[inv]
     cand_vecs = flat[: b * c].reshape(b, c, -1)
     his_vecs = flat[b * c :].reshape(b, h, -1)
@@ -454,6 +546,9 @@ def _build_local_step(
         )
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
+        # trace-time cap resolution: each compiled per-client batch shape
+        # gets the bound its own B implies (bucketed policy or the global)
+        cap = resolve_unique_cap(cfg, batch["labels"].shape[0])
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
         # text-encoder dropout key must be IDENTICAL across seq shards so the
         # replicated candidate encode stays replicated (finetune mode)
@@ -536,13 +631,14 @@ def _build_local_step(
                         cand_vecs, his_vecs = _batch_news_vecs_tokens(
                             text_encoder, news_params, table,
                             batch["candidates"], batch["history"], enc_rng,
-                            cap=cfg.data.unique_news_cap,
+                            cap=cap,
                         )
                     else:
                         cand_vecs, his_vecs = _batch_news_vecs(
                             model, news_params, table,
                             batch["candidates"], batch["history"],
-                            cap=cfg.data.unique_news_cap,
+                            cap=cap,
+                            chunk=cfg.data.gather_chunk,
                         )
                     if n_seq > 1:
                         # candidate encoding is replicated across seq shards;
@@ -649,7 +745,7 @@ def _build_local_step(
         mean_loss = lax.pmean(loss, axis_name=sync_axes)
         metrics = {"loss": loss, "mean_loss": mean_loss}
         capped = (
-            cfg.data.unique_news_cap
+            cap
             and not use_dpsgd
             and (mode == "joint" or (mode == "finetune" and n_seq == 1))
         )
@@ -661,7 +757,7 @@ def _build_local_step(
             # bypassing the capped joint dedup — so no flag there.)
             flag = unique_overflow(
                 batch["candidates"], batch["history"],
-                cfg.data.unique_news_cap, table.shape[0],
+                cap, table.shape[0],
             )
             if n_seq > 1:
                 # each seq shard dedups its own history slice, so overflow
@@ -693,6 +789,7 @@ def build_fed_train_step(
     mesh: Mesh,
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
+    donate_batch: bool = False,
 ) -> Callable:
     """Compile the per-batch federated train step.
 
@@ -702,6 +799,11 @@ def build_fed_train_step(
     ``feature_table`` is replicated — token states for ``joint`` mode, the
     news-vector table for ``decoupled`` mode. Step math and the LDP/DP
     hooks are documented on ``_build_local_step``.
+
+    ``donate_batch`` additionally donates the batch buffers (the Trainer
+    device_puts fresh arrays every dispatch, so XLA may reclaim them as
+    scratch once consumed); leave False when re-dispatching the same batch
+    arrays (bench.py's chain timer does).
     """
     local_step, k, batch_spec, axis = _build_local_step(
         model, cfg, strategy, mesh, mode, noise_fn
@@ -717,7 +819,9 @@ def build_fed_train_step(
     def sharded_step(stacked_state, batch, table):
         return _cohort_call(local_step, k, 2, stacked_state, batch, table)
 
-    return jax.jit(sharded_step, donate_argnums=(0,))
+    return jax.jit(
+        sharded_step, donate_argnums=(0, 1) if donate_batch else (0,)
+    )
 
 
 def _prepend_none(spec: Any) -> Any:
@@ -735,6 +839,7 @@ def build_fed_train_scan(
     mesh: Mesh,
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
+    donate_batch: bool = False,
 ) -> Callable:
     """Epoch-in-jit: ``lax.scan`` the train step over a STACK of batches.
 
@@ -768,7 +873,9 @@ def build_fed_train_scan(
 
         return lax.scan(one, stacked_state, batches)
 
-    return jax.jit(sharded_scan, donate_argnums=(0,))
+    return jax.jit(
+        sharded_scan, donate_argnums=(0, 1) if donate_batch else (0,)
+    )
 
 
 def stack_batches(batches: list) -> dict:
@@ -814,6 +921,7 @@ def build_fed_round_scan(
     mesh: Mesh,
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
+    donate_batch: bool = False,
 ) -> Callable:
     """Rounds-in-jit: whole federated ROUNDS in one XLA dispatch.
 
@@ -866,7 +974,9 @@ def build_fed_round_scan(
 
         return lax.scan(one_round, stacked_state, (batches, weights))
 
-    return jax.jit(sharded_rounds, donate_argnums=(0,))
+    return jax.jit(
+        sharded_rounds, donate_argnums=(0, 1) if donate_batch else (0,)
+    )
 
 
 def stack_rounds(round_batches: list) -> dict:

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from fedrec_tpu.config import ExperimentConfig
 from fedrec_tpu.data.batcher import IndexedSamples, TrainBatcher, index_samples
 from fedrec_tpu.data.mind import MindData
+from fedrec_tpu.data.prefetch import maybe_prefetch
 from fedrec_tpu.fed.strategies import get_strategy
 from fedrec_tpu.models import NewsRecommender
 from fedrec_tpu.parallel.mesh import (
@@ -43,6 +44,7 @@ from fedrec_tpu.train.checkpoint import SnapshotManager
 from fedrec_tpu.train.state import init_client_state, replicate_state
 from fedrec_tpu.train.step import (
     build_eval_step,
+    build_fed_round_scan,
     build_fed_train_step,
     build_full_eval_step,
     build_full_eval_step_sharded,
@@ -51,8 +53,10 @@ from fedrec_tpu.train.step import (
     build_param_sync,
     encode_all_news,
     encode_all_news_sharded,
+    shard_round_batches,
     shard_scan_batches,
     stack_batches,
+    stack_rounds,
 )
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
@@ -162,19 +166,47 @@ class Trainer:
                 data.valid_samples, data.nid2index, cfg.data.max_his_len
             )
 
-        # jitted programs
+        # jitted programs. Batch-buffer donation (train.donate_batch) is
+        # safe HERE because every dispatch device_puts fresh arrays; the
+        # builders default it off for direct callers that reuse batches.
         self.train_step = build_fed_train_step(
-            self.model, cfg, self.strategy, self.mesh, mode=self.mode
+            self.model, cfg, self.strategy, self.mesh, mode=self.mode,
+            donate_batch=cfg.train.donate_batch,
         )
         # epoch-in-jit chains (train.scan_steps > 1): one dispatch per
         # scan_steps batches; the tail of an epoch uses train_step
         self.train_scan = (
             build_fed_train_scan(
-                self.model, cfg, self.strategy, self.mesh, mode=self.mode
+                self.model, cfg, self.strategy, self.mesh, mode=self.mode,
+                donate_batch=cfg.train.donate_batch,
             )
             if cfg.train.scan_steps > 1
             else None
         )
+        # rounds-in-jit (train.rounds_per_scan > 1): whole rounds — every
+        # local epoch plus the round-end sync — in one compiled dispatch.
+        # run() chunks rounds so chunk boundaries always land on eval/save
+        # cadence rounds; trajectory equality is pinned in tests/test_scan.py.
+        self.round_scan = None
+        if cfg.train.rounds_per_scan > 1:
+            if self.mode == "decoupled":
+                raise ValueError(
+                    "train.rounds_per_scan > 1 is not supported with "
+                    "model.text_encoder_mode='table' (decoupled mode): the "
+                    "epoch-end news_update/table refresh is a host-driven "
+                    "program between epochs. Use mode 'head' or 'finetune', "
+                    "or train.scan_steps for epoch-in-jit."
+                )
+            if self.server_opt is not None:
+                raise ValueError(
+                    "train.rounds_per_scan > 1 is incompatible with "
+                    "fed.server_opt: FedOpt steps round deltas host-side at "
+                    "every round boundary. Disable one of the two."
+                )
+            self.round_scan = build_fed_round_scan(
+                self.model, cfg, self.strategy, self.mesh, mode=self.mode,
+                donate_batch=cfg.train.donate_batch,
+            )
         self.news_update = build_news_update_step(
             self.model, cfg, self.mesh, self.strategy
         )
@@ -541,13 +573,40 @@ class Trainer:
         return self._table
 
     # ------------------------------------------------------------------
+    def _epoch_batch_iter(self, epoch_idx: int):
+        """Epoch batches as step-ready dicts, built ahead on a bounded
+        producer thread when ``data.prefetch_batches`` > 0 — batch t+1
+        assembles (shuffle, negative sampling, packing) while step t runs
+        on device, closing the dispatch gap the step_profile host-pipeline
+        rows measure. Off (0) = plain inline iteration, identical batches
+        either way (tests/test_prefetch.py)."""
+        return maybe_prefetch(
+            self.batcher.epoch_batches_sharded(
+                self.cfg.fed.num_clients, epoch_idx
+            ),
+            self.cfg.data.prefetch_batches,
+            transform=lambda b: {
+                "candidates": b.candidates,
+                "history": b.history,
+                "labels": b.labels,
+            },
+        )
+
+    def _mask_rng(self, round_idx: int) -> jax.Array:
+        """THE per-round participation-mask key — host-driven rounds and
+        rounds-in-jit chunks both derive masks from this one expression, so
+        the chunked path's identical-trajectory contract cannot be broken
+        by editing one copy."""
+        return jax.random.PRNGKey(
+            hash((self.cfg.train.seed, round_idx)) & 0x7FFFFFFF
+        )
+
     def train_round(self, round_idx: int) -> RoundResult:
         cfg = self.cfg
-        mask_rng = jax.random.PRNGKey(hash((cfg.train.seed, round_idx)) & 0x7FFFFFFF)
         from fedrec_tpu.fed.strategies import participation_mask
 
         weights = participation_mask(
-            mask_rng, cfg.fed.num_clients, cfg.fed.participation
+            self._mask_rng(round_idx), cfg.fed.num_clients, cfg.fed.participation
         )
 
         round_start_global = None
@@ -590,19 +649,20 @@ class Trainer:
             epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
             table = self._feature_table()
             group: list = []
-            for batch in self.batcher.epoch_batches_sharded(
-                cfg.fed.num_clients, epoch_idx
-            ):
-                group.append(
-                    {
-                        "candidates": batch.candidates,
-                        "history": batch.history,
-                        "labels": batch.labels,
-                    }
-                )
-                if len(group) == scan_s:
-                    dispatch(group, table)
-                    group = []
+            it = self._epoch_batch_iter(epoch_idx)
+            try:
+                for batch in it:
+                    group.append(batch)
+                    if len(group) == scan_s:
+                        dispatch(group, table)
+                        group = []
+            finally:
+                # a dispatch error mid-epoch must not leak the producer
+                # thread (Prefetcher.close is idempotent; bare generators
+                # close harmlessly)
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
             if group:
                 dispatch(group, table)
             if self.mode == "decoupled":
@@ -644,13 +704,7 @@ class Trainer:
                 np.sum([np.asarray(o).max(axis=-1).sum() for o in overflows])
             )
             if total > 0:
-                raise RuntimeError(
-                    f"data.unique_news_cap={cfg.data.unique_news_cap} "
-                    f"overflowed on {total} step(s) this round — the capped "
-                    "unique-news dedup dropped ids and the gradients are "
-                    "invalid. Raise the cap (or set it to 0 for the exact "
-                    "worst-case bound)."
-                )
+                raise RuntimeError(self._overflow_message(total))
         # flat mean over every (step, client) cell: scan chains contribute one
         # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
         # so a mean-of-entry-means would overweight the epoch tail
@@ -658,15 +712,155 @@ class Trainer:
             np.mean(np.concatenate([np.asarray(l).reshape(-1) for l in losses]))
         )
         result = RoundResult(round_idx, train_loss)
-        if self.valid_ix is not None and (round_idx + 1) % self.cfg.train.eval_every == 0:
-            protocol = self.cfg.train.eval_protocol  # validated in __init__
-            if protocol == "full":
-                result.val_metrics = self.evaluate_full()
-            elif protocol == "last4":
-                result.val_metrics = self.evaluate_full(last_k=4)
-            else:
-                result.val_metrics = self.evaluate()
+        self._eval_if_due(result)
         return result
+
+    def _overflow_message(self, total: int) -> str:
+        cfg = self.cfg
+        policy = (
+            f"data.unique_news_cap_buckets={cfg.data.unique_news_cap_buckets!r}"
+            if cfg.data.unique_news_cap_buckets
+            else f"data.unique_news_cap={cfg.data.unique_news_cap}"
+        )
+        return (
+            f"{policy} overflowed on {total} step(s) this round — the "
+            "capped unique-news dedup dropped ids and the gradients are "
+            "invalid. Raise the cap (or set it to 0 for the exact "
+            "worst-case bound)."
+        )
+
+    def _eval_if_due(self, result: RoundResult) -> None:
+        """Round-cadence evaluation (train.eval_every), shared by the
+        host-driven round and the rounds-in-jit chunk tail."""
+        if self.valid_ix is None:
+            return
+        if (result.round_idx + 1) % self.cfg.train.eval_every != 0:
+            return
+        protocol = self.cfg.train.eval_protocol  # validated in __init__
+        if protocol == "full":
+            result.val_metrics = self.evaluate_full()
+        elif protocol == "last4":
+            result.val_metrics = self.evaluate_full(last_k=4)
+        else:
+            result.val_metrics = self.evaluate()
+
+    # ----------------------------------------------------- rounds-in-jit
+    def _round_is_boundary(self, round_idx: int) -> bool:
+        """True when host-side work is due AFTER this round — evaluation
+        (eval_every), a snapshot (save_every / final round), or the end of
+        training — so a compiled round chunk must not run past it."""
+        cfg = self.cfg
+        if round_idx >= cfg.fed.rounds - 1:
+            return True
+        if self.valid_ix is not None and (round_idx + 1) % cfg.train.eval_every == 0:
+            return True
+        if self.snapshots is not None and (round_idx + 1) % cfg.train.save_every == 0:
+            return True
+        return False
+
+    def _round_chunk(self, round_idx: int) -> int:
+        """How many rounds starting at ``round_idx`` may run in one
+        compiled chunk: up to ``train.rounds_per_scan``, never crossing a
+        cadence boundary (so checkpoint/eval behavior is byte-identical to
+        the host-driven loop)."""
+        if self.round_scan is None:
+            return 1
+        n = 1
+        while (
+            n < self.cfg.train.rounds_per_scan
+            and round_idx + n < self.cfg.fed.rounds
+            and not self._round_is_boundary(round_idx + n - 1)
+        ):
+            n += 1
+        return n
+
+    def _train_rounds_scan(self, round_idx: int, num_rounds: int) -> list[RoundResult]:
+        """Execute ``num_rounds`` whole federated rounds in ONE compiled
+        dispatch via ``build_fed_round_scan`` — every local epoch's steps
+        plus each round-end participation-weighted sync. The host builds
+        the (rounds, steps, clients, ...) batch stack up front — straight
+        off the batcher, no prefetcher: with a single dispatch at the end
+        there is no device work to overlap the build with — so the device
+        sees zero host round-trips until the chunk's final readback.
+
+        Identical trajectory to ``train_round`` driven ``num_rounds``
+        times: same step body, same sync policy, same per-round
+        participation masks (same rng derivation) — pinned in
+        ``tests/test_scan.py``.
+        """
+        cfg = self.cfg
+        from fedrec_tpu.fed.strategies import participation_mask
+
+        weights = np.stack([
+            np.asarray(
+                participation_mask(
+                    self._mask_rng(r),
+                    cfg.fed.num_clients,
+                    cfg.fed.participation,
+                )
+            )
+            for r in range(round_idx, round_idx + num_rounds)
+        ])
+        table = self._feature_table()
+
+        round_lists: list[list[dict]] = []
+        steps: int | None = None
+        for r in range(round_idx, round_idx + num_rounds):
+            batches: list[dict] = []
+            for local_epoch in range(cfg.fed.local_epochs):
+                epoch_idx = r * cfg.fed.local_epochs + local_epoch
+                batches.extend(
+                    {
+                        "candidates": b.candidates,
+                        "history": b.history,
+                        "labels": b.labels,
+                    }
+                    for b in self.batcher.epoch_batches_sharded(
+                        cfg.fed.num_clients, epoch_idx
+                    )
+                )
+            if steps is None:
+                steps = len(batches)
+            elif len(batches) != steps:
+                # static (rounds, steps) shapes are the contract; a varying
+                # per-epoch step count cannot stack
+                raise RuntimeError(
+                    f"rounds-in-jit needs a constant steps-per-round, got "
+                    f"{steps} then {len(batches)}"
+                )
+            round_lists.append(batches)
+        if not steps:
+            raise ValueError(
+                "no batches: dataset smaller than num_clients*batch_size"
+            )
+
+        stacked = shard_round_batches(self.mesh, stack_rounds(round_lists), cfg)
+        self.state, metrics = self.round_scan(
+            self.state, stacked, table, jnp.asarray(weights)
+        )
+
+        if "unique_overflow" in metrics:
+            # (rounds, steps, clients): max over clients (replicated psum
+            # total), then count every overflowed step in the chunk
+            total = int(
+                np.asarray(metrics["unique_overflow"]).max(axis=-1).sum()
+            )
+            if total > 0:
+                raise RuntimeError(self._overflow_message(total))
+
+        mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
+        results = []
+        for i in range(num_rounds):
+            # flat mean over every (step, client) cell — same reduction as
+            # the host-driven round's loss bookkeeping
+            results.append(
+                RoundResult(round_idx + i, float(mean_loss[i].mean()))
+            )
+        # only the chunk's last round can sit on an eval boundary
+        # (_round_chunk guarantees it); earlier rounds get no metrics, same
+        # as host-driven rounds off the eval cadence
+        self._eval_if_due(results[-1])
+        return results
 
     def evaluate(self, client: int | None = None) -> dict[str, float]:
         """Mean validation metrics over all impressions (fixes the reference's
@@ -800,81 +994,99 @@ class Trainer:
         cfg = self.cfg
         history: list[RoundResult] = []
         with profile_if(cfg.train.profile):
-            for round_idx in range(self.start_round, cfg.fed.rounds):
-                result = self.train_round(round_idx)
-                history.append(result)
-                log = {"round": round_idx, "training_loss": result.train_loss}
-                if result.val_metrics:
-                    named = {
-                        "validation_loss": result.val_metrics.get("loss"),
-                        "valid_auc": result.val_metrics.get("auc"),
-                        "valid_mrr": result.val_metrics.get("mrr"),
-                        "val_ndcg@5": result.val_metrics.get("ndcg5"),
-                        "val_ndcg@10": result.val_metrics.get("ndcg10"),
-                    }
-                    # the full-pool protocols have no loss key — omit, don't
-                    # log null
-                    log.update({k: v for k, v in named.items() if v is not None})
-                self.logger.log(round_idx, log)
-                auc = (
-                    result.val_metrics.get("auc")
-                    if result.val_metrics else None
-                )
-                if (
-                    self.best_snapshots is not None
-                    and auc is not None
-                    and (self._best_auc is None or auc > self._best_auc)
-                ):
-                    import json as _json
-
-                    from fedrec_tpu.train.checkpoint import atomic_write_bytes
-
-                    # a failed best-write must not kill training (the
-                    # round-cadence config.json persistence has the same
-                    # policy) and must not advance _best_auc — a later
-                    # round between the persisted and the failed best
-                    # still deserves a save
-                    try:
-                        # blocking: the marker must never describe a
-                        # snapshot that is still in flight
-                        self.best_snapshots.save(
-                            round_idx, self.state, wait=True
-                        )
-                        atomic_write_bytes(
-                            self.best_snapshots.directory / "best.json",
-                            _json.dumps(
-                                {"round": round_idx, "auc": float(auc)}
-                            ).encode(),
-                        )
-                        atomic_write_bytes(
-                            self.best_snapshots.directory / "config.json",
-                            cfg.to_json().encode(),
-                        )
-                        self._best_auc = float(auc)
-                    except OSError as e:
-                        print(
-                            f"[trainer] could not persist best snapshot "
-                            f"at round {round_idx}: {e}"
-                        )
-                if self.snapshots is not None and (
-                    (round_idx + 1) % cfg.train.save_every == 0
-                    or round_idx == cfg.fed.rounds - 1
-                ):
-                    # blocking save under FedOpt: the sidecar must never be
-                    # newer than the orbax snapshot it pairs with (a crash
-                    # between an async save and the sidecar write would
-                    # resume round-r momentum against round r-k params)
-                    self.snapshots.save(
-                        round_idx, self.state, wait=self.server_opt is not None
-                    )
-                    if self.server_opt is not None:
-                        from fedrec_tpu.train.checkpoint import atomic_write_bytes
-
-                        atomic_write_bytes(
-                            self.snapshots.directory / "server_opt_state.msgpack",
-                            self.server_opt.state_bytes(round_idx),
-                        )
+            round_idx = self.start_round
+            while round_idx < cfg.fed.rounds:
+                # rounds-in-jit: chunks of up to train.rounds_per_scan
+                # rounds in one dispatch, always breaking at eval/save
+                # cadence boundaries so the host-side bookkeeping below
+                # sees exactly the rounds it would host-driven
+                chunk = self._round_chunk(round_idx)
+                if chunk > 1:
+                    results = self._train_rounds_scan(round_idx, chunk)
+                else:
+                    results = [self.train_round(round_idx)]
+                for result in results:
+                    history.append(result)
+                    self._after_round(result)
+                round_idx += len(results)
         if self.snapshots is not None:
             self.snapshots.wait()  # settle async saves before handing back
         self.logger.finish()
         return history
+
+    def _after_round(self, result: RoundResult) -> None:
+        """Per-round host bookkeeping: metric logging, best-AUC snapshot,
+        cadence snapshots (+ FedOpt sidecar)."""
+        cfg = self.cfg
+        round_idx = result.round_idx
+        log = {"round": round_idx, "training_loss": result.train_loss}
+        if result.val_metrics:
+            named = {
+                "validation_loss": result.val_metrics.get("loss"),
+                "valid_auc": result.val_metrics.get("auc"),
+                "valid_mrr": result.val_metrics.get("mrr"),
+                "val_ndcg@5": result.val_metrics.get("ndcg5"),
+                "val_ndcg@10": result.val_metrics.get("ndcg10"),
+            }
+            # the full-pool protocols have no loss key — omit, don't
+            # log null
+            log.update({k: v for k, v in named.items() if v is not None})
+        self.logger.log(round_idx, log)
+        auc = (
+            result.val_metrics.get("auc")
+            if result.val_metrics else None
+        )
+        if (
+            self.best_snapshots is not None
+            and auc is not None
+            and (self._best_auc is None or auc > self._best_auc)
+        ):
+            import json as _json
+
+            from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+            # a failed best-write must not kill training (the
+            # round-cadence config.json persistence has the same
+            # policy) and must not advance _best_auc — a later
+            # round between the persisted and the failed best
+            # still deserves a save
+            try:
+                # blocking: the marker must never describe a
+                # snapshot that is still in flight
+                self.best_snapshots.save(
+                    round_idx, self.state, wait=True
+                )
+                atomic_write_bytes(
+                    self.best_snapshots.directory / "best.json",
+                    _json.dumps(
+                        {"round": round_idx, "auc": float(auc)}
+                    ).encode(),
+                )
+                atomic_write_bytes(
+                    self.best_snapshots.directory / "config.json",
+                    cfg.to_json().encode(),
+                )
+                self._best_auc = float(auc)
+            except OSError as e:
+                print(
+                    f"[trainer] could not persist best snapshot "
+                    f"at round {round_idx}: {e}"
+                )
+        if self.snapshots is not None and (
+            (round_idx + 1) % cfg.train.save_every == 0
+            or round_idx == cfg.fed.rounds - 1
+        ):
+            # blocking save under FedOpt: the sidecar must never be
+            # newer than the orbax snapshot it pairs with (a crash
+            # between an async save and the sidecar write would
+            # resume round-r momentum against round r-k params)
+            self.snapshots.save(
+                round_idx, self.state, wait=self.server_opt is not None
+            )
+            if self.server_opt is not None:
+                from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(
+                    self.snapshots.directory / "server_opt_state.msgpack",
+                    self.server_opt.state_bytes(round_idx),
+                )

@@ -100,9 +100,31 @@ def write_artifact(path: Path, payload: dict, partial: bool) -> None:
         sidecar.unlink(missing_ok=True)
 
 
+def runtime_versions() -> dict:
+    """Installed jax/jaxlib versions via package metadata — read WITHOUT
+    importing jax (an import here could trigger backend init, which hangs
+    on a wedged tunnel; see the provenance() backend probe below).
+
+    Recorded so a cached-replay reader can tell that a dependency-pin bump
+    changed the installed runtime between the measurement and HEAD even
+    when no tracked file moved (ADVICE r5 #3): ``bench._cache_delta``
+    compares this stamp against the replaying process's own versions.
+    """
+    from importlib import metadata
+
+    out = {}
+    for pkg in ("jax", "jaxlib"):
+        try:
+            out[pkg] = metadata.version(pkg)
+        except Exception:  # noqa: BLE001 — absent package stays absent
+            pass
+    return out
+
+
 def provenance(**extra) -> dict:
-    """Stamp: commit, wall time, machine, CPU count, and the JAX backend
-    actually in use (when JAX is already imported — never imports it)."""
+    """Stamp: commit, wall time, machine, CPU count, installed jax/jaxlib
+    versions, and the JAX backend actually in use (when JAX is already
+    imported — never imports it)."""
     stamp = {
         "commit": git_head(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -110,6 +132,7 @@ def provenance(**extra) -> dict:
         "machine": _platform.machine(),
         "nproc": os.cpu_count(),
         "dirty_paths": git_dirty_paths(),
+        "runtime_versions": runtime_versions(),
     }
     import sys
 

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Tier-1 verify in one line — the EXACT pipeline from ROADMAP.md, so builder
+# and reviewer stop pasting it by hand. Prints the DOTS_PASSED count (dots in
+# pytest's progress lines — the roadmap's cross-session pass metric) and
+# exits with pytest's status.
+#
+#   scripts/t1.sh          # or: make t1
+#
+# Log lands in /tmp/_t1.log for post-mortems.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

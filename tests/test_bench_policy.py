@@ -22,6 +22,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from bench import _baseline_ratios, _promote_best_sweep_row
+from fedrec_tpu.utils.provenance import runtime_versions
 
 
 def _flops_of(b):
@@ -214,7 +215,10 @@ def test_cache_delta_docs_only_is_not_measurement_affecting(tmp_path):
     (tmp_path / "README.md").write_text("v2\n")
     _git(tmp_path, "add", "-A")
     _git(tmp_path, "commit", "-qm", "docs")
-    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    d = _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=[],
+        measured_versions=runtime_versions(),
+    )
     assert d["cache_delta_paths"] == ["README.md"]
     assert d["cache_delta_affecting_paths"] == []
     assert d["cache_delta_is_measurement_affecting"] is False
@@ -258,7 +262,10 @@ def test_cache_delta_spacey_doc_path_not_fragmented(tmp_path):
     (tmp_path / "old bench.py").write_text("# notes\n")
     _git(tmp_path, "add", "-A")
     _git(tmp_path, "commit", "-qm", "scratch")
-    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    d = _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=[],
+        measured_versions=runtime_versions(),
+    )
     assert d["cache_delta_affecting_paths"] == []
     assert d["cache_delta_is_measurement_affecting"] is False
 
@@ -281,6 +288,7 @@ def test_cache_delta_dirty_tree_rules(tmp_path):
         tmp_path,
         ["benchmarks/last_tpu_bench.json"],
         measured_dirty_paths=["benchmarks/last_tpu_bench.json"],
+        measured_versions=runtime_versions(),
     )["cache_delta_is_measurement_affecting"] is False
     assert _cache_delta(base, tmp_path, None, measured_dirty_paths=[])[
         "cache_delta_is_measurement_affecting"
@@ -367,3 +375,68 @@ def test_affects_measurement_includes_dependency_pins():
     assert not _affects_measurement("README.md")
     assert not _affects_measurement("docs/requirements.md")
     assert not _affects_measurement("benchmarks/last_tpu_bench.json")
+
+
+def test_cache_delta_posthoc_dirty_stamp_cannot_certify_clean(tmp_path):
+    """A hand-added measured_dirty_paths (measured_dirty_paths_posthoc=True,
+    ADVICE r5 #4) documents a claim, not a measurement: even with a clean
+    path delta and matching runtime versions the verdict stays affecting,
+    and the annotation is surfaced."""
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    (tmp_path / "README.md").write_text("v2\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "docs")
+    d = _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=[],
+        measured_dirty_posthoc=True, measured_versions=runtime_versions(),
+    )
+    assert d["cache_delta_affecting_paths"] == []
+    assert d["cache_delta_measured_dirty_posthoc"] is True
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_cache_delta_runtime_pin_change_flips_verdict(tmp_path):
+    """A jax/jaxlib version difference between the measure-time stamp and
+    the replaying process flips the staleness verdict even when no tracked
+    file changed (ADVICE r5 #3) — and the delta names the versions."""
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)  # no commits after base: clean path delta
+    now = runtime_versions()
+    stale = dict(now)
+    stale["jax"] = "0.0.1"  # a pin the current runtime does not match
+    d = _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=[], measured_versions=stale
+    )
+    assert d["cache_delta_affecting_paths"] == []
+    assert d["cache_delta_runtime_versions_changed"] is True
+    assert d["cache_delta_runtime_version_delta"]["jax"]["measured"] == "0.0.1"
+    assert d["cache_delta_is_measurement_affecting"] is True
+    # matching versions on the same clean delta certify clean
+    d2 = _cache_delta(
+        base, tmp_path, [], measured_dirty_paths=[], measured_versions=now
+    )
+    assert d2["cache_delta_runtime_versions_changed"] is False
+    assert d2["cache_delta_is_measurement_affecting"] is False
+
+
+def test_cache_delta_missing_version_stamp_is_unknowable(tmp_path):
+    """Artifacts stamped before runtime_versions existed cannot certify the
+    runtime didn't change: verdict affecting, changed-flag None (unknowable),
+    matching the measured_dirty_paths fail-unsafe precedent."""
+    from bench import _cache_delta
+
+    base = _mini_repo(tmp_path)
+    d = _cache_delta(base, tmp_path, [], measured_dirty_paths=[])
+    assert d["cache_delta_runtime_versions_changed"] is None
+    assert d["cache_delta_is_measurement_affecting"] is True
+
+
+def test_provenance_records_runtime_versions():
+    from fedrec_tpu.utils.provenance import provenance, runtime_versions
+
+    vers = runtime_versions()
+    assert "jax" in vers and "jaxlib" in vers  # installed in this image
+    assert provenance()["runtime_versions"] == vers

@@ -50,11 +50,22 @@ def test_bench_replay_emits_annotated_cache():
     if not d["cache_is_current_tree"]:
         assert isinstance(d["cache_delta_paths"], list)
         assert isinstance(d["cache_delta_is_measurement_affecting"], bool)
+        def _is_loading_path(p: str) -> bool:
+            name = p.rsplit("/", 1)[-1]
+            return (
+                p in ("bench.py", "benchmarks/baseline_host.json",
+                      "pyproject.toml")
+                or p.startswith(("fedrec_tpu/", "native/"))
+                # dependency-pin files change the installed runtime
+                or (name.startswith("requirements")
+                    and name.endswith((".txt", ".in")))
+                or name.endswith(".lock")
+                or name == "environment.yml"
+            )
+
         bad = [
             p for p in d["cache_delta_affecting_paths"]
-            if not (p == "bench.py"
-                    or p == "benchmarks/baseline_host.json"
-                    or p.startswith(("fedrec_tpu/", "native/")))
+            if not _is_loading_path(p)
         ]
         assert bad == []
     # the fresh CPU run rides along, smoke-labeled so it is never quoted

@@ -1,0 +1,131 @@
+"""Guarantees of the bounded host prefetcher (``fedrec_tpu/data/prefetch.py``):
+determinism vs the bare iterator, bounded queue depth under a slow consumer,
+and clean shutdown — exception relay mid-epoch and no leaked producer
+threads on early exit."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.data import Prefetcher, TrainBatcher, index_samples, maybe_prefetch
+from fedrec_tpu.data import make_synthetic_mind
+from fedrec_tpu.data.prefetch import _Stop  # noqa: F401 (import sanity)
+
+
+def _batcher(seed=0, batch_size=8):
+    data = make_synthetic_mind(
+        num_news=64, num_train=128, num_valid=8, title_len=12,
+        his_len_range=(2, 10), seed=seed,
+    )
+    ix = index_samples(data.train_samples, data.nid2index, 10)
+    return TrainBatcher(ix, batch_size, npratio=4, seed=seed)
+
+
+def _arrays(b):
+    return (b.candidates, b.history, b.labels)
+
+
+def test_prefetch_yields_identical_batches_in_order():
+    """Prefetch is a scheduling change, never a data change: same batches,
+    same order, same contents as the bare iterator — including through the
+    sharded multi-client path the Trainer drives."""
+    batcher = _batcher()
+    bare = [_arrays(b) for b in batcher.epoch_batches_sharded(4, epoch=1)]
+    pre = [
+        _arrays(b)
+        for b in Prefetcher(batcher.epoch_batches_sharded(4, epoch=1), depth=2)
+    ]
+    assert len(bare) == len(pre) and len(bare) > 0
+    for (c1, h1, l1), (c2, h2, l2) in zip(bare, pre):
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(h1, h2)
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_prefetch_transform_runs_and_order_holds():
+    out = list(Prefetcher(range(100), depth=3, transform=lambda x: x * 2))
+    assert out == [x * 2 for x in range(100)]
+    # maybe_prefetch(depth=0) applies the transform inline, same contract
+    assert list(maybe_prefetch(range(10), 0, lambda x: x + 1)) == list(range(1, 11))
+
+
+def test_prefetch_depth_is_bounded_under_slow_consumer():
+    """The producer may run at most ``depth`` items ahead of the consumer
+    (+1 for the item in flight between queue.put and the source advance)."""
+    produced = []
+
+    def source():
+        for i in range(50):
+            produced.append(i)
+            yield i
+
+    depth = 2
+    pf = Prefetcher(source(), depth=depth)
+    it = iter(pf)
+    consumed = 0
+    for _ in range(5):
+        next(it)
+        consumed += 1
+        time.sleep(0.05)  # slow consumer: producer would race ahead if unbounded
+        assert len(produced) <= consumed + depth + 1, (len(produced), consumed)
+    pf.close()
+
+
+def test_prefetch_relays_midepoch_exception_at_position():
+    """A producer-side exception surfaces in the consumer exactly where the
+    failed item would have been — earlier batches still arrive intact."""
+
+    def source():
+        yield from range(3)
+        raise RuntimeError("batch build failed mid-epoch")
+
+    pf = Prefetcher(source(), depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="mid-epoch"):
+        for x in pf:
+            got.append(x)
+    assert got == [0, 1, 2]
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_close_unblocks_producer_and_joins():
+    """Early consumer exit (break / .close()) must not leak a producer
+    thread blocked on the full queue."""
+    pf = Prefetcher(iter(range(10_000)), depth=1)
+    it = iter(pf)
+    assert next(it) == 0
+    it.close()  # generator close -> Prefetcher.close() via finally
+    deadline = time.time() + 5
+    while pf._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not pf._thread.is_alive(), "producer thread leaked after close()"
+    # idempotent
+    pf.close()
+
+
+def test_prefetch_context_manager_closes():
+    with Prefetcher(iter(range(1000)), depth=1) as pf:
+        it = iter(pf)
+        assert next(it) == 0
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        Prefetcher(range(3), depth=0)
+
+
+def test_prefetch_threads_do_not_accumulate():
+    """Repeated epochs (the Trainer builds one Prefetcher per epoch) leave
+    no thread residue."""
+    before = threading.active_count()
+    for _ in range(5):
+        assert list(Prefetcher(range(20), depth=2)) == list(range(20))
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before

@@ -279,6 +279,106 @@ def test_round_scan_matches_host_round_loop(strategy, max_dev):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+def test_trainer_rounds_per_scan_matches_host_loop(tmp_path):
+    """The PRODUCTION rounds-in-jit path: Trainer with train.rounds_per_scan=4
+    reproduces the host-driven round loop exactly — per-round losses, eval
+    metrics at the eval_every cadence, and the snapshot directory contents
+    (save_every=2 forces a MID-RUN snapshot boundary, so chunks must break
+    there: rounds run as two compiled chunks of 2). Prefetch is enabled on
+    the scan run so the overlapped input pipeline is covered by the same
+    pin."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    def run(rounds_per_scan, prefetch, snap):
+        cfg = small_cfg(optim__user_lr=3e-3)
+        cfg.model.text_encoder_mode = "head"  # joint mode
+        cfg.fed.strategy = "param_avg"
+        cfg.fed.rounds = 4
+        cfg.train.rounds_per_scan = rounds_per_scan
+        cfg.data.prefetch_batches = prefetch
+        cfg.train.snapshot_dir = str(snap)
+        cfg.train.save_every = 2
+        cfg.train.eval_every = 2
+        data, token_states = _trainer_fixture(cfg, num_train=128)
+        t = Trainer(cfg, data, token_states)
+        if rounds_per_scan > 1:
+            # cadence boundaries after rounds 1 and 3 split the 4 rounds
+            # into two compiled chunks
+            assert t._round_chunk(0) == 2 and t._round_chunk(2) == 2
+        return t.run()
+
+    host = run(1, 0, tmp_path / "host")
+    scan = run(4, 2, tmp_path / "scan")
+    assert [h.round_idx for h in host] == [h.round_idx for h in scan]
+    np.testing.assert_allclose(
+        [h.train_loss for h in host], [h.train_loss for h in scan], rtol=1e-6
+    )
+    # eval cadence: metrics appear on exactly the same rounds, same values
+    assert [bool(h.val_metrics) for h in host] == [bool(h.val_metrics) for h in scan]
+    assert any(h.val_metrics for h in host)
+    for a, b in zip(host, scan):
+        for k in a.val_metrics:
+            np.testing.assert_allclose(
+                a.val_metrics[k], b.val_metrics[k], rtol=1e-5, atol=1e-6
+            )
+    # checkpoint cadence: identical snapshot directory layout, incl. the
+    # mid-run round-1 snapshot a chunk running past the boundary would skip
+    host_files = sorted(p.name for p in (tmp_path / "host").iterdir())
+    assert "1" in host_files
+    assert host_files == sorted(p.name for p in (tmp_path / "scan").iterdir())
+
+
+def test_trainer_round_chunk_boundary_math(tmp_path):
+    """_round_chunk never crosses an eval/save boundary or the end of
+    training, and never exceeds train.rounds_per_scan (pure host logic — no
+    compiled programs run)."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = small_cfg()
+    cfg.model.text_encoder_mode = "head"
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = 10
+    cfg.train.rounds_per_scan = 8
+    cfg.train.snapshot_dir = str(tmp_path / "snap")
+    cfg.train.save_every = 5
+    cfg.train.eval_every = 3
+    data, token_states = _trainer_fixture(cfg, num_train=128)
+    t = Trainer(cfg, data, token_states)
+    # eval after rounds 2, 5, 8; save after rounds 4, 9; end at 9
+    assert t._round_chunk(0) == 3   # stop after round 2 (eval)
+    assert t._round_chunk(3) == 2   # stop after round 4 (save)
+    assert t._round_chunk(5) == 1   # round 5 is itself an eval boundary
+    assert t._round_chunk(6) == 3   # stop after round 8 (eval)
+    assert t._round_chunk(9) == 1   # final round
+    # no eval set -> only save/end boundaries bite
+    t.valid_ix = None
+    assert t._round_chunk(0) == 5
+
+
+def test_trainer_rounds_per_scan_rejects_unsupported_modes(tmp_path):
+    """Fail-fast validation: decoupled mode (host-driven epoch-end
+    news_update) and FedOpt (host-side server optimizer) cannot run
+    rounds-in-jit."""
+    from fedrec_tpu.train.trainer import Trainer
+
+    cfg = small_cfg()
+    cfg.model.text_encoder_mode = "table"  # decoupled
+    cfg.train.rounds_per_scan = 2
+    cfg.train.snapshot_dir = str(tmp_path / "a")
+    data, token_states = _trainer_fixture(cfg, num_train=128)
+    with pytest.raises(ValueError, match="rounds_per_scan"):
+        Trainer(cfg, data, token_states)
+
+    cfg2 = small_cfg()
+    cfg2.model.text_encoder_mode = "head"
+    cfg2.fed.strategy = "param_avg"
+    cfg2.fed.server_opt = "adam"
+    cfg2.train.rounds_per_scan = 2
+    cfg2.train.snapshot_dir = str(tmp_path / "b")
+    with pytest.raises(ValueError, match="server_opt"):
+        Trainer(cfg2, data, token_states)
+
+
 def test_round_scan_gru_cohorts_compose():
     """Rounds-in-jit composed with the GRU user tower AND k=2 cohorts.
 

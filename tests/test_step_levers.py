@@ -1,0 +1,124 @@
+"""Hot-path levers added for the MFU-cliff work (train/step.py):
+
+  * ``resolve_unique_cap`` — the per-B bucketed unique-news-cap policy
+    (one global constant either over-caps small batches or silently
+    overflows large ones);
+  * ``data.gather_chunk`` — tiled, rematerialized token-state gather+encode
+    (exact same math, bounded HBM footprint);
+  * ``donate_batch`` — builder option the Trainer uses to let XLA reclaim
+    batch buffers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedrec_tpu.fed import get_strategy
+from fedrec_tpu.parallel import client_mesh, shard_batch
+from fedrec_tpu.train import build_fed_train_step, resolve_unique_cap
+
+from test_train import make_setup, small_cfg, _batch_dict
+
+
+def test_resolve_unique_cap_buckets():
+    cfg = small_cfg()
+    cfg.data.unique_news_cap_buckets = "64:2560,256:4096"
+    assert resolve_unique_cap(cfg, 8) == 2560
+    assert resolve_unique_cap(cfg, 64) == 2560
+    assert resolve_unique_cap(cfg, 65) == 4096
+    assert resolve_unique_cap(cfg, 256) == 4096
+    # past every bucket: uncapped (exact) — the fix for the flagship 2,560
+    # cap overflowing every B>=128 batch
+    assert resolve_unique_cap(cfg, 1024) == 0
+    # no buckets -> the global constant
+    cfg.data.unique_news_cap_buckets = ""
+    cfg.data.unique_news_cap = 7
+    assert resolve_unique_cap(cfg, 1024) == 7
+    # entries may arrive unsorted and spaced
+    cfg.data.unique_news_cap_buckets = " 256:4096 , 64:2560 "
+    assert resolve_unique_cap(cfg, 10) == 2560
+
+
+@pytest.mark.parametrize(
+    "bad", ["64", "64:2560:1", "x:1", "0:5", "8:-1", "64:2560,64:4096"]
+)
+def test_resolve_unique_cap_rejects_malformed(bad):
+    cfg = small_cfg()
+    cfg.data.unique_news_cap_buckets = bad
+    with pytest.raises(ValueError):
+        resolve_unique_cap(cfg, 64)
+
+
+def test_tiled_gather_matches_untiled_and_bucketed_cap_flags_overflow():
+    """data.gather_chunk tiles the unique gather+encode in rematerialized
+    lax.map chunks — the trajectory must match the untiled step exactly
+    (row-wise encode; tiling is a memory layout choice, not math). The same
+    dispatch also pins that a bucketed cap resolves per the traced B and
+    drives the overflow metric."""
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    mesh = client_mesh(8)
+    data, batcher, token_states, model, st0, _ = make_setup(cfg, seed=0)
+    b = next(batcher.epoch_batches_sharded(8, 0))
+    batch = _batch_dict(b)
+
+    step = build_fed_train_step(
+        model, cfg, get_strategy("grad_avg"), mesh, mode="joint"
+    )
+    st1, m1 = step(st0, shard_batch(mesh, batch), token_states)
+
+    cfg_t = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    cfg_t.data.gather_chunk = 16  # B*(C+H) = 120 slots -> 8 tiles
+    _, _, _, _, st0b, _ = make_setup(cfg_t, seed=0)
+    step_t = build_fed_train_step(
+        model, cfg_t, get_strategy("grad_avg"), mesh, mode="joint"
+    )
+    st2, m2 = step_t(st0b, shard_batch(mesh, batch), token_states)
+
+    np.testing.assert_allclose(
+        np.asarray(m1["mean_loss"]), np.asarray(m2["mean_loss"]),
+        rtol=1e-6, atol=1e-7,
+    )
+    # gradients agree to f32 reassociation (measured ~1e-9 absolute); the
+    # atol floor covers one pathological leaf — the additive-attention
+    # normalization bias, whose true grad cancels to ~1e-10, where Adam's
+    # first step amplifies reassociation noise through g/(sqrt(g^2)+eps)
+    for a, c in zip(
+        jax.tree_util.tree_leaves((st1.user_params, st1.news_params)),
+        jax.tree_util.tree_leaves((st2.user_params, st2.news_params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-4
+        )
+
+    # bucketed cap: B=8 -> cap 2 (guaranteed overflow on a real batch);
+    # the metric must flag it so results are never silently corrupted
+    cfg_c = small_cfg()
+    cfg_c.data.unique_news_cap_buckets = "8:2,128:4096"
+    _, _, _, _, st0c, _ = make_setup(cfg_c, seed=0)
+    step_c = build_fed_train_step(
+        model, cfg_c, get_strategy("grad_avg"), mesh, mode="joint"
+    )
+    _, m3 = step_c(st0c, shard_batch(mesh, batch), token_states)
+    assert int(np.max(np.asarray(m3["unique_overflow"]))) > 0
+
+
+def test_donate_batch_step_runs_with_fresh_buffers():
+    """donate_batch=True (the Trainer's configuration) must keep the step
+    correct when every dispatch receives freshly device-put batches."""
+    cfg = small_cfg(optim__user_lr=3e-3, optim__news_lr=3e-3)
+    mesh = client_mesh(8)
+    data, batcher, token_states, model, st0, _ = make_setup(cfg, seed=0)
+    step_d = build_fed_train_step(
+        model, cfg, get_strategy("grad_avg"), mesh, mode="joint",
+        donate_batch=True,
+    )
+    losses = []
+    for i, b in enumerate(batcher.epoch_batches_sharded(8, 0)):
+        st0, m = step_d(st0, shard_batch(mesh, _batch_dict(b)), token_states)
+        losses.append(float(np.mean(np.asarray(m["mean_loss"]))))
+        if i >= 2:
+            break
+    assert all(np.isfinite(l) for l in losses)
