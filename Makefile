@@ -1,11 +1,16 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 native
+.PHONY: t1 native obs-smoke
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
 	@bash scripts/t1.sh
+
+# observability smoke: 2-round CPU training + serve_load, then assert the
+# artifact trio (metrics.jsonl / trace.json / prometheus.txt) renders
+obs-smoke:
+	@bash scripts/obs_smoke.sh
 
 native:
 	$(MAKE) -C native

@@ -202,12 +202,18 @@ def main() -> int:
     p.add_argument("--deadline-ms", type=float, default=100.0)
     p.add_argument("--duration", type=float, default=10.0, help="per-mode seconds")
     p.add_argument("--out", default="serve_load.json")
+    p.add_argument("--obs-dir", default=None,
+                   help="also write the obs artifact trio (metrics.jsonl, "
+                        "trace.json, prometheus.txt) for fedrec-obs report")
     args = p.parse_args()
 
     import jax
 
+    from fedrec_tpu.obs import get_tracer
     from fedrec_tpu.utils.provenance import provenance, write_artifact
 
+    # span recording only pays off when --obs-dir will save the trace
+    get_tracer().enabled = bool(args.obs_dir)
     rows = asyncio.run(run(args))
     out = {
         "metric": "serving_load",
@@ -223,6 +229,12 @@ def main() -> int:
         "provenance": provenance(),
     }
     write_artifact(Path(__file__).with_name(args.out), out, partial=False)
+    if args.obs_dir:
+        from fedrec_tpu.obs import dump_artifacts
+
+        paths = dump_artifacts(args.obs_dir)
+        print(f"obs artifacts: {paths['metrics']} {paths['trace']} "
+              f"{paths['prometheus']}")
     print(f"closed: {rows['closed']['throughput_rps']} rps "
           f"p99={rows['closed']['latency'].get('p99_ms')}ms | "
           f"open@{args.rate}rps: p99={rows['open']['latency'].get('p99_ms')}ms "

@@ -1,0 +1,109 @@
+"""``fedrec-obs`` — render a run's observability artifacts.
+
+Consumes the artifact trio every instrumented entry point writes
+(Trainer with ``obs.dir``, ``fedrec-serve --obs-dir``,
+``benchmarks/serve_load.py --obs-dir``):
+
+* ``metrics.jsonl``   — MetricLogger records + registry snapshots
+* ``trace.json``      — Chrome-trace/Perfetto host spans
+* ``prometheus.txt``  — final text exposition
+
+Subcommands:
+
+  fedrec-obs report <dir | metrics.jsonl> [--trace trace.json] [--json]
+      One-page run report: round throughput, loss trajectory, serve
+      p50/p99, prefetch stalls, epsilon-spent trajectory, cap-overflow
+      counts, host-span summary.
+
+  fedrec-obs prom <dir | metrics.jsonl>
+      Re-render the LAST registry snapshot in the event log as a
+      Prometheus text exposition (for a run that predates, or lost, its
+      prometheus.txt).
+
+Imports no JAX — usable on any box the artifacts were copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from fedrec_tpu.obs.registry import snapshot_to_prometheus
+from fedrec_tpu.obs.report import (
+    build_report,
+    load_jsonl,
+    load_trace,
+    render_text,
+)
+
+
+def _resolve(path_arg: str) -> tuple[Path, Path | None]:
+    """A directory (the obs.dir layout) or an explicit metrics.jsonl path
+    -> (metrics_path, trace_path_or_None)."""
+    p = Path(path_arg)
+    if p.is_dir():
+        metrics = p / "metrics.jsonl"
+        trace = p / "trace.json"
+        return metrics, (trace if trace.exists() else None)
+    return p, None
+
+
+def _cmd_report(args) -> int:
+    metrics_path, trace_path = _resolve(args.path)
+    if args.trace:
+        trace_path = Path(args.trace)
+    if not metrics_path.exists():
+        print(f"fedrec-obs: no event log at {metrics_path}", file=sys.stderr)
+        return 2
+    records, snapshots = load_jsonl(metrics_path)
+    trace_events = load_trace(trace_path) if trace_path else None
+    report = build_report(records, snapshots, trace_events)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_text(report))
+    return 0
+
+
+def _cmd_prom(args) -> int:
+    metrics_path, _ = _resolve(args.path)
+    if not metrics_path.exists():
+        print(f"fedrec-obs: no event log at {metrics_path}", file=sys.stderr)
+        return 2
+    _, snapshots = load_jsonl(metrics_path)
+    if not snapshots:
+        print(f"fedrec-obs: no registry snapshot in {metrics_path}",
+              file=sys.stderr)
+        return 2
+    # the SAME renderer the live {"cmd": "prometheus"} endpoint uses —
+    # offline output cannot drift from the wire exposition
+    print(snapshot_to_prometheus(snapshots[-1]), end="")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fedrec-obs", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="render the one-page run report")
+    rep.add_argument("path", help="obs dir or metrics.jsonl path")
+    rep.add_argument("--trace", default=None, help="explicit trace.json path")
+    rep.add_argument("--json", action="store_true",
+                     help="machine-readable report instead of text")
+    rep.set_defaults(fn=_cmd_report)
+    prom = sub.add_parser(
+        "prom", help="Prometheus exposition from the last registry snapshot"
+    )
+    prom.add_argument("path", help="obs dir or metrics.jsonl path")
+    prom.set_defaults(fn=_cmd_prom)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

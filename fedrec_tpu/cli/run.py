@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="synthetic corpus size (train samples)")
     p.add_argument("--synthetic-news", type=int, default=512,
                    help="synthetic corpus size (distinct news)")
+    p.add_argument("--obs-dir", default=None,
+                   help="write observability artifacts here (shorthand for "
+                        "--set obs.dir=...); render with fedrec-obs report")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="SECTION.KEY=VALUE")
     return p
@@ -92,6 +95,8 @@ def main(argv: list[str] | None = None) -> int:
     cfg.fed.num_clients = args.clients or len(jax.local_devices())
     if args.mode:
         cfg.model.text_encoder_mode = "table" if args.mode == "decoupled" else "head"
+    if args.obs_dir:
+        cfg.obs.dir = args.obs_dir
     cfg.apply_overrides(args.overrides)
 
     if args.synthetic:

@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(N, L, bert_hidden) .npy of cached trunk states")
     p.add_argument("--metrics-every", type=float, default=30.0,
                    help="seconds between metric JSON lines on stdout")
+    p.add_argument("--obs-dir", default=None,
+                   help="write observability artifacts here (metrics.jsonl "
+                        "event log, trace.json host spans, prometheus.txt "
+                        "exposition); render with fedrec-obs report")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="SECTION.KEY=VALUE")
     return p
@@ -149,11 +153,24 @@ def main(argv: list[str] | None = None) -> int:
     if service is None:
         return 2
     service.warmup()  # compile every bucket before accepting traffic
-    logger = MetricLogger()
+    from fedrec_tpu.obs import get_tracer
+
+    # spans are only worth their memory when something will save them:
+    # without --obs-dir this process never writes trace.json, so recording
+    # per-request spans would just fill the bounded buffer with dead weight
+    get_tracer().enabled = bool(args.obs_dir)
+    jsonl = None
+    if args.obs_dir:
+        from pathlib import Path as _Path
+
+        _Path(args.obs_dir).mkdir(parents=True, exist_ok=True)
+        jsonl = str(_Path(args.obs_dir) / "metrics.jsonl")
+    logger = MetricLogger(jsonl_path=jsonl)
     try:
         asyncio.run(serve_forever(
             service, host=args.host, port=args.port,
             metrics_every_s=args.metrics_every, logger=logger,
+            obs_dir=args.obs_dir,
         ))
     except KeyboardInterrupt:
         print("[serve] interrupted; shutting down", file=sys.stderr)

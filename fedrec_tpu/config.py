@@ -217,6 +217,22 @@ class PrivacyConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Unified telemetry (fedrec_tpu.obs): registry snapshots + host spans.
+
+    The registry and tracer always record in memory (cheap); ``dir``
+    turns on the file artifacts — ``metrics.jsonl`` (MetricLogger
+    records + per-round registry snapshots), ``trace.json``
+    (Chrome-trace/Perfetto host spans), ``prometheus.txt`` (final text
+    exposition).  ``fedrec-obs report <dir>`` renders them.
+    """
+
+    dir: str = ""                      # "" = no files written
+    snapshot_every: int = 1            # rounds between registry snapshots
+    trace_capacity: int = 200_000      # host-span ring bound (earliest kept)
+
+
+@dataclass
 class TrainConfig:
     total_epochs: int = 10
     save_every: int = 1                # snapshot cadence (reference main.py argv)
@@ -274,6 +290,7 @@ class ExperimentConfig:
     fed: FedConfig = field(default_factory=FedConfig)
     privacy: PrivacyConfig = field(default_factory=PrivacyConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     # ------------------------------------------------------------------ io
     def to_dict(self) -> dict[str, Any]:

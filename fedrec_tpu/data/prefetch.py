@@ -28,6 +28,13 @@ Guarantees (pinned in ``tests/test_prefetch.py``):
 The producer holds no JAX state; when a ``transform`` is given (e.g. the
 Trainer's dict packaging) it runs on the producer thread too, off the
 dispatch path.
+
+Queue health is first-class telemetry (:mod:`fedrec_tpu.obs`): a
+``data.prefetch.queue_depth`` gauge plus producer-stall (queue full —
+the device is the bottleneck, good) and consumer-stall (queue empty —
+batch build is the bottleneck, the dispatch gap is back) counters, so
+"is prefetch actually hiding the host work?" is answerable from a
+registry snapshot instead of a profiler session.
 """
 
 from __future__ import annotations
@@ -35,6 +42,8 @@ from __future__ import annotations
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator
+
+from fedrec_tpu.obs import get_registry
 
 
 class _Stop:
@@ -61,6 +70,7 @@ class Prefetcher:
         source: Iterable,
         depth: int,
         transform: Callable[[Any], Any] | None = None,
+        registry=None,
     ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
@@ -68,6 +78,21 @@ class Prefetcher:
         self._stop = threading.Event()
         self._source = iter(source)
         self._transform = transform
+        reg = registry or get_registry()
+        self._depth_gauge = reg.gauge(
+            "data.prefetch.queue_depth", "batches ready in the handoff queue"
+        )
+        self._producer_stalls = reg.counter(
+            "data.prefetch.producer_stall_total",
+            "items that waited on a full queue (device is the bottleneck)",
+        )
+        self._consumer_stalls = reg.counter(
+            "data.prefetch.consumer_stall_total",
+            "consumer reads that found the queue empty (batch build is the bottleneck)",
+        )
+        self._items = reg.counter(
+            "data.prefetch.items_total", "batches delivered through the prefetcher"
+        )
         self._thread = threading.Thread(
             target=self._produce, name="fedrec-prefetch", daemon=True
         )
@@ -77,9 +102,15 @@ class Prefetcher:
     def _put(self, item: Any) -> bool:
         """Blocking put that stays responsive to close(): returns False when
         the consumer has gone away (item dropped, producer should exit)."""
+        if self._q.full():
+            # the producer is about to wait on the consumer — the healthy
+            # direction (device-bound); counted at put-entry because the
+            # timed put below masks sub-timeout waits
+            self._producer_stalls.inc()
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
+                self._depth_gauge.set(self._q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -102,11 +133,17 @@ class Prefetcher:
     def __iter__(self) -> Iterator:
         try:
             while True:
+                if self._q.empty():
+                    # the step is about to wait on batch build — the exact
+                    # dispatch-gap signal the prefetcher exists to remove
+                    self._consumer_stalls.inc()
                 item = self._q.get()
+                self._depth_gauge.set(self._q.qsize())
                 if item is _Stop:
                     return
                 if isinstance(item, _Raised):
                     raise item.exc
+                self._items.inc()
                 yield item
         finally:
             # reached on StopIteration, consumer break, generator .close(),

@@ -1,0 +1,59 @@
+"""Unified telemetry: metrics registry, span tracing, export surfaces.
+
+Three pieces, one namespace:
+
+* :mod:`fedrec_tpu.obs.registry` — process-wide metrics registry
+  (counters / gauges / fixed-bucket histograms; labeled, thread-safe,
+  snapshot-able).  ``MetricLogger``, the serving server/batcher/store,
+  the prefetcher, the Trainer and the DP accountant all publish here
+  instead of keeping ad-hoc dicts.
+* :mod:`fedrec_tpu.obs.tracing` — host-side span tracer emitting
+  Chrome-trace/Perfetto JSON; the Trainer pairs its spans with
+  ``jax.profiler.StepTraceAnnotation`` so host spans and XLA device
+  steps correlate by round number.
+* :mod:`fedrec_tpu.obs.report` — JSONL event log + snapshots + trace
+  -> one run report; Prometheus text exposition via
+  ``MetricsRegistry.to_prometheus`` (served by the serving admin
+  protocol's ``{"cmd": "prometheus"}`` and the ``fedrec-obs prom`` CLI).
+
+The package imports no JAX — serving and CLI paths pull it in cheaply.
+Metric name catalogue and operator how-to: ``docs/OBSERVABILITY.md``.
+"""
+
+from fedrec_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    sanitize_prom_name,
+    set_registry,
+)
+from fedrec_tpu.obs.report import (
+    build_report,
+    dump_artifacts,
+    load_jsonl,
+    load_trace,
+    render_text,
+)
+from fedrec_tpu.obs.tracing import Tracer, get_tracer, set_tracer
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "build_report",
+    "dump_artifacts",
+    "get_registry",
+    "get_tracer",
+    "load_jsonl",
+    "load_trace",
+    "render_text",
+    "sanitize_prom_name",
+    "set_registry",
+    "set_tracer",
+]

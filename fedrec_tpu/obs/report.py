@@ -1,0 +1,323 @@
+"""Run-report renderer: JSONL event log + registry snapshots + trace -> one page.
+
+The artifact contract (written by the Trainer under ``obs.dir``, by
+``fedrec-serve --obs-dir``, and by ``benchmarks/serve_load.py --obs-dir``):
+
+* ``metrics.jsonl`` — interleaved JSON lines of two kinds:
+  - metric-log records (``MetricLogger`` schema: ``{"step": ..,
+    "elapsed_sec": .., "training_loss": .., ...}``), and
+  - registry snapshots (``{"kind": "registry_snapshot", "ts": ..,
+    "metrics": {...}}``, one per round / one at shutdown);
+* ``trace.json`` — Chrome-trace/Perfetto host spans
+  (:mod:`fedrec_tpu.obs.tracing`);
+* ``prometheus.txt`` — final text exposition (scrape-equivalent).
+
+``build_report`` digests those into one dict (round throughput, loss
+trajectory, serve p50/p99, prefetch stalls, epsilon-spent trajectory,
+cap-overflow counts, span summary) and ``render_text`` prints it — the
+``fedrec-obs`` CLI is a thin wrapper over these two calls.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+
+def load_jsonl(path) -> tuple[list[dict], list[dict]]:
+    """Split a metrics JSONL file into (metric_log_records, snapshots).
+    Unparseable lines are skipped (a crashed writer may leave a torn tail)."""
+    records: list[dict] = []
+    snapshots: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if obj.get("kind") == "registry_snapshot":
+                snapshots.append(obj)
+            else:
+                records.append(obj)
+    return records, snapshots
+
+
+def load_trace(path) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if isinstance(e, dict)]
+
+
+# ------------------------------------------------------- snapshot accessors
+def _metric_values(snap: dict, name: str) -> list[dict]:
+    m = snap.get("metrics", {}).get(name)
+    return m.get("values", []) if m else []
+
+
+def snapshot_value(snap: dict, name: str, labels: dict | None = None) -> float | None:
+    """First matching counter/gauge cell value in a snapshot, else None."""
+    for row in _metric_values(snap, name):
+        if labels is None or row.get("labels") == labels:
+            if "value" in row:
+                return row["value"]
+    return None
+
+
+def snapshot_total(snap: dict, name: str) -> float | None:
+    """Sum over ALL label cells of a counter/gauge (e.g. the per-bucket
+    ``serve.batches_total``); None when the metric has no cells."""
+    values = [row["value"] for row in _metric_values(snap, name) if "value" in row]
+    return sum(values) if values else None
+
+
+def snapshot_histogram(snap: dict, name: str) -> dict | None:
+    for row in _metric_values(snap, name):
+        if "buckets" in row:
+            return row
+    return None
+
+
+def histogram_quantile(row: dict, q: float) -> float | None:
+    """Quantile from an exported snapshot histogram row — parses the
+    ``{"le": count}`` dict into (bounds, counts) and delegates to
+    :func:`fedrec_tpu.obs.registry.quantile_from_counts`, the ONE
+    estimator ``Histogram.quantile`` also uses."""
+    from fedrec_tpu.obs.registry import quantile_from_counts
+
+    buckets = row.get("buckets", {})
+    if not buckets or not row.get("count"):
+        return None
+    bounds: list[float] = []
+    counts: list[int] = []
+    inf_count = 0
+    for le, n in buckets.items():
+        if le == "+Inf":
+            inf_count = n
+        else:
+            bounds.append(float(le))
+            counts.append(n)
+    order = sorted(range(len(bounds)), key=lambda i: bounds[i])
+    bounds = [bounds[i] for i in order]
+    counts = [counts[i] for i in order]
+    counts.append(inf_count)
+    return quantile_from_counts(q, bounds, counts)
+
+
+# -------------------------------------------------------------- the report
+def build_report(
+    records: list[dict],
+    snapshots: list[dict],
+    trace_events: list[dict] | None = None,
+) -> dict:
+    report: dict[str, Any] = {}
+
+    # ---- training rounds (MetricLogger schema: round + training_loss)
+    rounds = [r for r in records if "round" in r and "training_loss" in r]
+    if rounds:
+        first, last = rounds[0], rounds[-1]
+        elapsed = float(last.get("elapsed_sec", 0)) - float(first.get("elapsed_sec", 0))
+        tr: dict[str, Any] = {
+            "rounds": len(rounds),
+            "first_loss": first["training_loss"],
+            "last_loss": last["training_loss"],
+        }
+        if len(rounds) > 1 and elapsed > 0:
+            tr["rounds_per_sec"] = round((len(rounds) - 1) / elapsed, 4)
+        evals = [r for r in rounds if "valid_auc" in r]
+        if evals:
+            tr["last_eval"] = {
+                k: evals[-1][k]
+                for k in ("valid_auc", "valid_mrr", "val_ndcg@5", "val_ndcg@10")
+                if k in evals[-1]
+            }
+        report["training"] = tr
+
+    # ---- epsilon trajectory (per-round records and/or snapshots)
+    def _round_key(r: dict):
+        k = r.get("round", r.get("step"))
+        # MetricLogger float-coerces numerics; a round index reads better whole
+        return int(k) if isinstance(k, float) and k.is_integer() else k
+
+    eps = [
+        (_round_key(r), r["privacy.epsilon_spent"])
+        for r in records
+        if "privacy.epsilon_spent" in r
+    ]
+    if not eps:
+        eps = [
+            (i, v)
+            for i, s in enumerate(snapshots)
+            if (v := snapshot_value(s, "privacy.epsilon_spent")) is not None
+        ]
+    if eps:
+        report["privacy"] = {
+            "epsilon_trajectory": eps,
+            "epsilon_spent": eps[-1][1],
+        }
+
+    last = snapshots[-1] if snapshots else None
+    if last is not None:
+        # ---- serving latency: prefer the collector gauges, fall back to
+        # the histogram estimate
+        p50 = snapshot_value(last, "serve.p50_ms")
+        p99 = snapshot_value(last, "serve.p99_ms")
+        hist = snapshot_histogram(last, "serve.latency_ms")
+        if p50 is None and hist is not None:
+            p50 = histogram_quantile(hist, 0.50)
+            p99 = histogram_quantile(hist, 0.99)
+        serve: dict[str, Any] = {}
+        if p50 is not None:
+            serve["p50_ms"] = round(p50, 3)
+        if p99 is not None:
+            serve["p99_ms"] = round(p99, 3)
+        for key, name in (
+            ("served", "serve.requests_total"),
+            ("rejected", "serve.rejected_total"),
+            ("deadline_missed", "serve.deadline_missed_total"),
+            ("batches", "serve.batches_total"),  # labeled per bucket: sum
+            ("queue_depth", "serve.queue_depth"),
+            ("generation", "serve.generation"),
+        ):
+            v = snapshot_total(last, name) if key == "batches" \
+                else snapshot_value(last, name)
+            if v is not None:
+                serve[key] = v
+        if serve:
+            report["serving"] = serve
+
+        # ---- prefetch health
+        pf = {
+            key: v
+            for key, name in (
+                ("queue_depth", "data.prefetch.queue_depth"),
+                ("producer_stalls", "data.prefetch.producer_stall_total"),
+                ("consumer_stalls", "data.prefetch.consumer_stall_total"),
+                ("items", "data.prefetch.items_total"),
+            )
+            if (v := snapshot_value(last, name)) is not None
+        }
+        if pf:
+            report["prefetch"] = pf
+
+        # ---- cap overflows
+        overflow = snapshot_value(last, "train.cap_overflow_total")
+        if overflow is not None:
+            report["cap_overflow_steps"] = overflow
+
+    # ---- span summary
+    if trace_events:
+        spans: dict[str, dict] = {}
+        for e in trace_events:
+            if e.get("ph") != "X":
+                continue
+            s = spans.setdefault(
+                e["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            )
+            dur_ms = float(e.get("dur", 0.0)) / 1e3
+            s["count"] += 1
+            s["total_ms"] += dur_ms
+            s["max_ms"] = max(s["max_ms"], dur_ms)
+        for s in spans.values():
+            s["total_ms"] = round(s["total_ms"], 3)
+            s["max_ms"] = round(s["max_ms"], 3)
+            s["mean_ms"] = round(s["total_ms"] / s["count"], 3) if s["count"] else 0.0
+        report["spans"] = dict(sorted(spans.items()))
+
+    return report
+
+
+def render_text(report: dict) -> str:
+    """Human-readable run report (the ``fedrec-obs report`` output)."""
+    lines: list[str] = ["# fedrec_tpu run report", ""]
+    tr = report.get("training")
+    if tr:
+        lines.append("## Training")
+        lines.append(f"rounds: {tr['rounds']}")
+        if "rounds_per_sec" in tr:
+            lines.append(f"round throughput: {tr['rounds_per_sec']} rounds/s")
+        lines.append(f"loss: {tr['first_loss']:.4f} -> {tr['last_loss']:.4f}")
+        if "last_eval" in tr:
+            ev = ", ".join(f"{k}={v:.4f}" for k, v in tr["last_eval"].items())
+            lines.append(f"last eval: {ev}")
+        lines.append("")
+    pv = report.get("privacy")
+    if pv:
+        lines.append("## Privacy")
+        lines.append(f"privacy.epsilon_spent: {pv['epsilon_spent']:.4f}")
+        traj = ", ".join(f"r{r}={e:.3f}" for r, e in pv["epsilon_trajectory"][-8:])
+        lines.append(f"trajectory (last 8): {traj}")
+        lines.append("")
+    sv = report.get("serving")
+    if sv:
+        lines.append("## Serving")
+        if "p50_ms" in sv or "p99_ms" in sv:
+            lines.append(
+                f"latency: p50={sv.get('p50_ms')}ms p99={sv.get('p99_ms')}ms"
+            )
+        counters = ", ".join(
+            f"{k}={int(sv[k])}"
+            for k in ("served", "rejected", "deadline_missed", "batches")
+            if k in sv
+        )
+        if counters:
+            lines.append(counters)
+        if "queue_depth" in sv:
+            lines.append(f"queue depth: {int(sv['queue_depth'])}")
+        lines.append("")
+    pf = report.get("prefetch")
+    if pf:
+        lines.append("## Prefetch")
+        lines.append(
+            "queue depth: "
+            f"{int(pf.get('queue_depth', 0))}, producer stalls: "
+            f"{int(pf.get('producer_stalls', 0))}, consumer stalls: "
+            f"{int(pf.get('consumer_stalls', 0))}"
+        )
+        lines.append("")
+    if "cap_overflow_steps" in report:
+        lines.append(f"cap-overflow steps: {int(report['cap_overflow_steps'])}")
+        lines.append("")
+    spans = report.get("spans")
+    if spans:
+        lines.append("## Host spans")
+        lines.append(f"{'name':<24} {'count':>7} {'total_ms':>10} {'mean_ms':>9} {'max_ms':>9}")
+        for name, s in spans.items():
+            lines.append(
+                f"{name:<24} {s['count']:>7} {s['total_ms']:>10} "
+                f"{s['mean_ms']:>9} {s['max_ms']:>9}"
+            )
+        lines.append("")
+    if len(lines) == 2:
+        lines.append("(no recognizable records — is this a fedrec obs artifact?)")
+    return "\n".join(lines)
+
+
+def dump_artifacts(obs_dir, registry=None, tracer=None) -> dict[str, str]:
+    """Write the run's observability artifacts into ``obs_dir``:
+    ``metrics.jsonl`` (append one final registry snapshot), ``trace.json``
+    (Perfetto host spans), ``prometheus.txt`` (text exposition).  Shared
+    shutdown path for the Trainer, ``fedrec-serve`` and ``serve_load``."""
+    from fedrec_tpu.obs.registry import get_registry
+    from fedrec_tpu.obs.tracing import get_tracer
+
+    registry = registry or get_registry()
+    tracer = tracer or get_tracer()
+    out_dir = Path(obs_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    paths = {
+        "metrics": str(out_dir / "metrics.jsonl"),
+        "trace": str(out_dir / "trace.json"),
+        "prometheus": str(out_dir / "prometheus.txt"),
+    }
+    registry.write_snapshot(paths["metrics"])
+    tracer.save(paths["trace"])
+    with open(paths["prometheus"], "w") as f:
+        f.write(registry.to_prometheus())
+    return paths

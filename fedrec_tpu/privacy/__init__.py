@@ -3,6 +3,7 @@ from fedrec_tpu.privacy.accountant import (
     calibrate_sigma,
     compute_epsilon,
     compute_rdp_subsampled_gaussian,
+    round_epsilon_schedule,
 )
 from fedrec_tpu.privacy.dpsgd import (
     clip_by_global_norm_per_example,
@@ -20,4 +21,5 @@ __all__ = [
     "make_ldp_news_noise_fn",
     "make_noise_fn",
     "per_example_clipped_grads",
+    "round_epsilon_schedule",
 ]

@@ -119,6 +119,47 @@ def calibrate_sigma(
     return hi
 
 
+def round_epsilon_schedule(cfg, n_train: int):
+    """``rounds_done -> epsilon`` for the run's actual step cadence.
+
+    The spend side of the accountant: where :func:`calibrate_from_config`
+    answers "what sigma meets the budget", this answers "how much of the
+    (epsilon, delta) budget has round k consumed" — the number the
+    Trainer publishes as the ``privacy.epsilon_spent`` gauge each round
+    (docs/OBSERVABILITY.md).  Same ``q`` and steps-per-epoch definitions
+    as calibration, so the trajectory's final value is comparable to the
+    configured target.  Requires ``cfg.privacy.sigma`` > 0 (calibrated
+    or explicit); results are memoized — one accountant evaluation per
+    new round, not per metric snapshot.
+    """
+    sigma = cfg.privacy.sigma
+    if sigma <= 0:
+        raise ValueError(
+            "privacy.sigma not set; calibrate it (calibrate_from_config) "
+            "before asking for a spent-epsilon schedule"
+        )
+    n_train = max(int(n_train), 1)
+    per_client = max(n_train // cfg.fed.num_clients, 1)
+    q = min(1.0, cfg.data.batch_size / per_client)
+    steps_per_round = (
+        max(per_client // cfg.data.batch_size, 1) * cfg.fed.local_epochs
+    )
+    delta = cfg.privacy.delta
+    cache: dict[int, float] = {}
+
+    def spent(rounds_done: int) -> float:
+        rounds_done = int(rounds_done)
+        if rounds_done <= 0:
+            return 0.0
+        if rounds_done not in cache:
+            cache[rounds_done] = compute_epsilon(
+                q, sigma, steps_per_round * rounds_done, delta
+            )
+        return cache[rounds_done]
+
+    return spent
+
+
 def calibrate_from_config(cfg, n_train: int) -> float:
     """Sigma for ``cfg.privacy`` given the total training-sample count.
 

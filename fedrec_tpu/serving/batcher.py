@@ -42,6 +42,8 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from fedrec_tpu.obs import get_registry, get_tracer
+
 
 class Backpressure(RuntimeError):
     """Queue depth exceeded ``max_queue``; request rejected at admission."""
@@ -85,6 +87,8 @@ class MicroBatcher:
         max_queue: int = 1024,
         deadline_margin_ms: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
+        registry=None,
+        tracer=None,
     ):
         if not batch_sizes or list(batch_sizes) != sorted(set(batch_sizes)):
             raise ValueError("batch_sizes must be sorted, unique, non-empty")
@@ -99,13 +103,32 @@ class MicroBatcher:
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._running = False
-        # ---- metrics
+        # ---- metrics. The plain attributes stay the source of truth for
+        # the wire `metrics()` dict (backward-compat keys); the registry
+        # instruments mirror them for snapshots/Prometheus, plus the
+        # latency histogram only the registry can hold.
         self.served = 0
         self.rejected = 0
         self.deadline_missed = 0
         self.batches_by_size: dict[int, int] = {b: 0 for b in self.batch_sizes}
         self._occupancy_sum = 0.0
         self._batches = 0
+        reg = registry or get_registry()
+        self.tracer = tracer or get_tracer()
+        self._m_served = reg.counter("serve.requests_total", "requests served")
+        self._m_rejected = reg.counter(
+            "serve.rejected_total", "requests shed at admission (backpressure)"
+        )
+        self._m_missed = reg.counter(
+            "serve.deadline_missed_total", "responses served past their deadline"
+        )
+        self._m_batches = reg.counter(
+            "serve.batches_total", "batches flushed", labels=("bucket",)
+        )
+        self._m_qdepth = reg.gauge("serve.queue_depth", "pending requests")
+        self._m_latency = reg.histogram(
+            "serve.latency_ms", "request latency, enqueue -> results (ms)"
+        )
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
@@ -144,6 +167,7 @@ class MicroBatcher:
             raise RuntimeError("batcher not started")
         if len(self._queue) >= self.max_queue:
             self.rejected += 1
+            self._m_rejected.inc()
             raise Backpressure(
                 f"queue depth {len(self._queue)} >= max_queue {self.max_queue}"
             )
@@ -155,6 +179,7 @@ class MicroBatcher:
             future=asyncio.get_running_loop().create_future(),
         )
         self._queue.append(pending)
+        self._m_qdepth.set(len(self._queue))
         self._wake.set()
         return await pending.future
 
@@ -191,12 +216,22 @@ class MicroBatcher:
     def _flush_one(self) -> None:
         take = min(len(self._queue), self.batch_sizes[-1])
         batch, self._queue = self._queue[:take], self._queue[take:]
+        self._m_qdepth.set(len(self._queue))
         bucket = next(b for b in self.batch_sizes if b >= take)
+        # request lifecycle spans (enqueue -> batch -> dispatch -> reply):
+        # the coalescing window ends here; its length is stamped from the
+        # batcher clock, only the duration crosses to the tracer clock
+        self.tracer.add_span(
+            "serve.queue_wait",
+            dur_s=self._clock() - min(p.enqueued for p in batch),
+            bucket=bucket, n=take,
+        )
         hist = np.zeros((bucket, self.history_len), np.int32)
         for i, p in enumerate(batch):
             hist[i] = p.history
         try:
-            ids, scores, generation = self._score(hist)
+            with self.tracer.span("serve.dispatch", bucket=bucket, n=take):
+                ids, scores, generation = self._score(hist)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the server
             for p in batch:
                 if not p.future.done():
@@ -207,24 +242,33 @@ class MicroBatcher:
         done = self._clock()
         self._batches += 1
         self.batches_by_size[bucket] += 1
+        self._m_batches.inc(bucket=bucket)
         self._occupancy_sum += take / bucket
-        for i, p in enumerate(batch):
-            met = p.deadline is None or done <= p.deadline
-            if not met:
-                self.deadline_missed += 1
-            self.served += 1
-            if not p.future.done():  # caller may have been cancelled
-                p.future.set_result(
-                    ServedResult(
-                        ids=ids[i],
-                        scores=scores[i],
-                        generation=int(generation),
-                        deadline_met=met,
-                        latency_ms=(done - p.enqueued) * 1e3,
-                        batch_size=bucket,
-                        occupancy=take / bucket,
-                    )
+        with self.tracer.span("serve.reply", bucket=bucket, n=take):
+            for i, p in enumerate(batch):
+                met = p.deadline is None or done <= p.deadline
+                if not met:
+                    self.deadline_missed += 1
+                    self._m_missed.inc()
+                self.served += 1
+                self._m_served.inc()
+                latency_ms = (done - p.enqueued) * 1e3
+                self._m_latency.observe(latency_ms)
+                self.tracer.add_span(
+                    "serve.request", dur_s=done - p.enqueued, bucket=bucket
                 )
+                if not p.future.done():  # caller may have been cancelled
+                    p.future.set_result(
+                        ServedResult(
+                            ids=ids[i],
+                            scores=scores[i],
+                            generation=int(generation),
+                            deadline_met=met,
+                            latency_ms=latency_ms,
+                            batch_size=bucket,
+                            occupancy=take / bucket,
+                        )
+                    )
 
     # ------------------------------------------------------------ metrics
     def metrics(self) -> dict:

@@ -9,6 +9,8 @@ carry the request's ``id`` back so clients may pipeline):
   "deadline_met": true, "latency_ms": 4.1}``
   (plus ``"news": [nid, ...]`` when the service holds an id map);
 * admin    ``{"cmd": "metrics"}`` → ``{"metrics": {...}}``;
+* admin    ``{"cmd": "prometheus"}`` → ``{"prometheus": "<text exposition>"}``
+  (the whole obs registry in Prometheus text format, docs/OBSERVABILITY.md);
 * admin    ``{"cmd": "refresh", "snapshot_dir": "...",
   "token_states": "...npy"}`` → hot-swap the embedding store from a
   training checkpoint and report the new generation;
@@ -35,10 +37,12 @@ import json
 import time
 from collections import deque
 from functools import partial
+from pathlib import Path
 from typing import Any
 
 import numpy as np
 
+from fedrec_tpu.obs import get_registry
 from fedrec_tpu.serving.batcher import Backpressure, MicroBatcher
 from fedrec_tpu.serving.retrieval import build_index, build_two_stage_fn
 from fedrec_tpu.serving.store import EmbeddingStore, EmptyStoreError
@@ -64,6 +68,7 @@ class ServingService:
         exact_threshold: int = 4096,
         id_map: dict[int, str] | None = None,
         latency_window: int = 8192,
+        registry=None,
     ):
         self.model = model
         self.store = store
@@ -73,16 +78,43 @@ class ServingService:
         self.n_probe = int(n_probe)
         self.exact_threshold = int(exact_threshold)
         self.id_map = id_map
+        self.registry = registry or get_registry()
         self.batcher = MicroBatcher(
             self._score_batch,
             history_len=history_len,
             batch_sizes=batch_sizes,
             flush_ms=flush_ms,
             max_queue=max_queue,
+            registry=self.registry,
         )
         self._fns: dict[int, Any] = {}
         self._latencies: deque[float] = deque(maxlen=latency_window)
         self._started_at = time.time()
+        # derived gauges refreshed lazily at snapshot/exposition time (a
+        # registry collector): percentile math per scrape, not per request
+        self._g_p50 = self.registry.gauge("serve.p50_ms", "median serve latency")
+        self._g_p99 = self.registry.gauge("serve.p99_ms", "p99 serve latency")
+        self._g_occ = self.registry.gauge(
+            "serve.mean_occupancy", "mean real-requests/bucket over served batches"
+        )
+        self._g_staleness = self.registry.gauge(
+            "serve.staleness_sec", "seconds since the serving generation was published"
+        )
+        self._g_uptime = self.registry.gauge("serve.uptime_sec", "service uptime")
+        self.registry.register_collector(self._collect)
+
+    def _collect(self) -> None:
+        lat = np.asarray(self._latencies, np.float64)
+        if lat.size:
+            self._g_p50.set(float(np.percentile(lat, 50)))
+            self._g_p99.set(float(np.percentile(lat, 99)))
+        occ = self.batcher.metrics().get("mean_occupancy")
+        if occ is not None:
+            self._g_occ.set(occ)
+        staleness = self.store.metrics().get("staleness_sec")
+        if staleness is not None:
+            self._g_staleness.set(staleness)
+        self._g_uptime.set(time.time() - self._started_at)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
@@ -90,6 +122,12 @@ class ServingService:
 
     async def stop(self) -> None:
         await self.batcher.stop()
+        # one final refresh so post-stop exposition/artifact dumps carry the
+        # service's last numbers, then detach: a stopped service must not
+        # keep publishing through the process registry (tests build many
+        # short-lived services)
+        self._collect()
+        self.registry.unregister_collector(self._collect)
 
     def warmup(self) -> None:
         """Compile every batch bucket against the current generation so the
@@ -193,6 +231,11 @@ class ServingService:
         cmd = req.get("cmd")
         if cmd == "metrics":
             return {"metrics": self.metrics()}
+        if cmd == "prometheus":
+            # text exposition over the admin protocol: a scraper sidecar
+            # (or curl | promtool) gets the full registry, not just the
+            # serving keys — the one-line Prometheus integration
+            return {"prometheus": self.registry.to_prometheus()}
         if cmd == "refresh":
             try:
                 prepared = await asyncio.get_running_loop().run_in_executor(
@@ -343,6 +386,7 @@ async def serve_forever(
     port: int = 7607,
     metrics_every_s: float = 30.0,
     logger=None,
+    obs_dir: str | None = None,
 ) -> None:
     """CLI entry loop: listen until SIGINT/SIGTERM, logging metrics
     periodically.  Shutdown is graceful BY CONSTRUCTION: the signal only
@@ -351,6 +395,8 @@ async def serve_forever(
     default handler tearing the loop down mid-batch."""
     import signal
 
+    if obs_dir is not None:
+        Path(obs_dir).mkdir(parents=True, exist_ok=True)
     server = await start_server(service, host, port)
     addr = server.sockets[0].getsockname()
     print(f"[serve] listening on {addr[0]}:{addr[1]}", flush=True)
@@ -370,6 +416,10 @@ async def serve_forever(
             step += 1
             if logger is not None:
                 service.log_metrics(logger, step)
+            if obs_dir is not None:
+                # periodic registry snapshots make the event log useful
+                # even when the server is killed rather than signalled
+                service.registry.write_snapshot(Path(obs_dir) / "metrics.jsonl")
 
     heartbeat = asyncio.ensure_future(beat())
     try:
@@ -380,3 +430,9 @@ async def serve_forever(
         server.close()
         await server.wait_closed()
         await service.stop()
+        if obs_dir is not None:
+            from fedrec_tpu.obs import dump_artifacts
+
+            paths = dump_artifacts(obs_dir, registry=service.registry)
+            print(f"[serve] obs artifacts in {obs_dir}: "
+                  f"{', '.join(sorted(paths))}", flush=True)

@@ -29,6 +29,8 @@ from typing import Any
 
 import numpy as np
 
+from fedrec_tpu.obs import get_registry
+
 
 class EmptyStoreError(RuntimeError):
     """``current()`` before any generation was published."""
@@ -60,11 +62,21 @@ class EmbeddingStore:
     ``swap_count`` consistent if two publishers ever race.
     """
 
-    def __init__(self, clock=time.time):
+    def __init__(self, clock=time.time, registry=None):
         self._clock = clock
         self._lock = threading.Lock()
         self._gen: Generation | None = None
         self._swap_count = 0
+        reg = registry or get_registry()
+        self._g_generation = reg.gauge(
+            "serve.generation", "embedding-store generation being served"
+        )
+        self._g_swaps = reg.gauge(
+            "serve.swap_count", "hot-swaps since the store was created"
+        )
+        self._g_num_news = reg.gauge(
+            "serve.num_news", "catalog rows in the current generation"
+        )
 
     # ------------------------------------------------------------ readers
     def current(self) -> Generation:
@@ -119,6 +131,9 @@ class EmbeddingStore:
             self._gen = gen  # the one atomic publish point
             if prev is not None:
                 self._swap_count += 1
+            self._g_generation.set(gen.generation)
+            self._g_swaps.set(self._swap_count)
+            self._g_num_news.set(gen.num_news)
             return gen
 
 

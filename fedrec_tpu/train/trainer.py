@@ -58,6 +58,7 @@ from fedrec_tpu.train.step import (
     stack_batches,
     stack_rounds,
 )
+from fedrec_tpu.obs import dump_artifacts, get_registry, get_tracer
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
 
@@ -335,10 +336,66 @@ class Trainer:
                         "tracking restarts this run"
                     )
 
+        # ---- observability (fedrec_tpu.obs): registry instruments, host
+        # spans, and the obs.dir artifact trio (metrics.jsonl / trace.json /
+        # prometheus.txt). The registry/tracer always record in memory;
+        # files only when obs.dir is set.
+        from pathlib import Path
+
+        self._obs_dir: Path | None = None
+        jsonl_path = None
+        if cfg.obs.dir:
+            self._obs_dir = Path(cfg.obs.dir)
+            self._obs_dir.mkdir(parents=True, exist_ok=True)
+            jsonl_path = str(self._obs_dir / "metrics.jsonl")
+        self.registry = get_registry()
+        self.tracer = get_tracer()
+        self.tracer.capacity = cfg.obs.trace_capacity
+        self._m_rounds = self.registry.counter(
+            "train.rounds_total", "federated rounds completed"
+        )
+        self._m_steps = self.registry.counter(
+            "train.steps_total", "train-step batches dispatched"
+        )
+        self._m_round_loss = self.registry.gauge(
+            "train.round_loss", "mean train loss of the last round"
+        )
+        self._m_round_secs = self.registry.histogram(
+            "train.round_seconds", "wall seconds per federated round",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                     100.0, 250.0, 500.0, 1000.0),
+        )
+        self._m_overflow = self.registry.counter(
+            "train.cap_overflow_total",
+            "unique-news cap overflow count (client-summed over steps; "
+            "nonzero aborts the round)",
+        )
+        # spent-epsilon trajectory: one gauge per round, next to loss/AUC.
+        # Only the rigorous mechanism gets a trajectory — ldp_news carries
+        # no (epsilon, delta) statement to spend against (docs/DP.md).
+        self._eps_schedule = None
+        if (
+            cfg.privacy.enabled
+            and cfg.privacy.mechanism == "dpsgd"
+            and cfg.privacy.sigma > 0
+        ):
+            from fedrec_tpu.privacy import round_epsilon_schedule
+
+            # num_local_samples is this process's shard — the same n the
+            # CLI drivers calibrated sigma against (cli/run.py passes the
+            # full corpus, cli/coordinator.py its local shard)
+            self._eps_schedule = round_epsilon_schedule(cfg, self.num_local_samples)
+            self._m_eps = self.registry.gauge(
+                "privacy.epsilon_spent",
+                "(epsilon, delta)-DP spent after the completed rounds",
+            )
+
         self.logger = MetricLogger(
             use_wandb=cfg.train.wandb,
             project=cfg.train.wandb_project,
             run_name=cfg.train.run_name,
+            jsonl_path=jsonl_path,
+            registry=self.registry,
         )
         self._table: jnp.ndarray | None = None  # decoupled-mode news-vec table
         self._adopt_fn = None  # lazy compiled set_global_params program
@@ -602,6 +659,20 @@ class Trainer:
         )
 
     def train_round(self, round_idx: int) -> RoundResult:
+        """One host-driven federated round, wrapped in a ``fed_round`` host
+        span AND a ``jax.profiler.StepTraceAnnotation`` carrying the same
+        round number — so the obs trace and a captured device trace
+        (train.profile) are correlatable round-for-round."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with self.tracer.span("fed_round", step_num=round_idx, num_rounds=1), \
+                jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx):
+            result = self._train_round_inner(round_idx)
+        self._m_round_secs.observe(_time.perf_counter() - t0)
+        return result
+
+    def _train_round_inner(self, round_idx: int) -> RoundResult:
         cfg = self.cfg
         from fedrec_tpu.fed.strategies import participation_mask
 
@@ -626,17 +697,27 @@ class Trainer:
         overflows = []  # device arrays; read once at round end (no per-step sync)
         scan_s = cfg.train.scan_steps if self.train_scan is not None else 1
 
+        tracer = self.tracer
+
         def dispatch(group: list, table) -> None:
+            self._m_steps.inc(len(group))
             if len(group) == scan_s and scan_s > 1:
-                stacked = shard_scan_batches(
-                    self.mesh, stack_batches(group), cfg
-                )
-                self.state, metrics = self.train_scan(self.state, stacked, table)
+                with tracer.span("h2d", n=len(group)):
+                    stacked = shard_scan_batches(
+                        self.mesh, stack_batches(group), cfg
+                    )
+                with tracer.span("dispatch", kind="scan_chain", n=len(group)):
+                    self.state, metrics = self.train_scan(
+                        self.state, stacked, table
+                    )
             else:  # per-batch path; also the short epoch tail under scan
                 for g in group:
-                    self.state, metrics = self.train_step(
-                        self.state, shard_fed_batch(self.mesh, g, cfg), table
-                    )
+                    with tracer.span("h2d", n=1):
+                        sharded = shard_fed_batch(self.mesh, g, cfg)
+                    with tracer.span("dispatch", kind="step", n=1):
+                        self.state, metrics = self.train_step(
+                            self.state, sharded, table
+                        )
                     losses.append(metrics["mean_loss"])
                     if "unique_overflow" in metrics:
                         overflows.append(metrics["unique_overflow"])
@@ -650,8 +731,21 @@ class Trainer:
             table = self._feature_table()
             group: list = []
             it = self._epoch_batch_iter(epoch_idx)
+            src = iter(it)
             try:
-                for batch in it:
+                while True:
+                    # the consumer-side wait IS the batch-build cost when
+                    # prefetch is off, and the residual (unhidden) build
+                    # cost when it is on — either way the span to watch
+                    t_build = tracer.now()
+                    try:
+                        batch = next(src)
+                    except StopIteration:
+                        break
+                    tracer.add_span(
+                        "batch_build", dur_s=tracer.now() - t_build,
+                        epoch=epoch_idx,
+                    )
                     group.append(batch)
                     if len(group) == scan_s:
                         dispatch(group, table)
@@ -672,7 +766,8 @@ class Trainer:
                 )
 
         if self.strategy.sync_params_every_round:
-            self.state = self.param_sync(self.state, weights)
+            with tracer.span("aggregate", round=round_idx):
+                self.state = self.param_sync(self.state, weights)
             if self.server_opt is not None:
                 # FedOpt: the weighted mean is a proposal, not the new model —
                 # the server optimizer steps the global from round_start
@@ -704,6 +799,7 @@ class Trainer:
                 np.sum([np.asarray(o).max(axis=-1).sum() for o in overflows])
             )
             if total > 0:
+                self._m_overflow.inc(total)
                 raise RuntimeError(self._overflow_message(total))
         # flat mean over every (step, client) cell: scan chains contribute one
         # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
@@ -737,12 +833,15 @@ class Trainer:
         if (result.round_idx + 1) % self.cfg.train.eval_every != 0:
             return
         protocol = self.cfg.train.eval_protocol  # validated in __init__
-        if protocol == "full":
-            result.val_metrics = self.evaluate_full()
-        elif protocol == "last4":
-            result.val_metrics = self.evaluate_full(last_k=4)
-        else:
-            result.val_metrics = self.evaluate()
+        with self.tracer.span(
+            "eval", round=result.round_idx, protocol=protocol
+        ):
+            if protocol == "full":
+                result.val_metrics = self.evaluate_full()
+            elif protocol == "last4":
+                result.val_metrics = self.evaluate_full(last_k=4)
+            else:
+                result.val_metrics = self.evaluate()
 
     # ----------------------------------------------------- rounds-in-jit
     def _round_is_boundary(self, round_idx: int) -> bool:
@@ -788,7 +887,29 @@ class Trainer:
         participation masks (same rng derivation) — pinned in
         ``tests/test_scan.py``.
         """
+        import time as _time
+
+        t0 = _time.perf_counter()
+        chunk_span = self.tracer.span(
+            "fed_round", step_num=round_idx, num_rounds=num_rounds
+        )
+        chunk_annotation = jax.profiler.StepTraceAnnotation(
+            "fed_round", step_num=round_idx
+        )
+        with chunk_span, chunk_annotation:
+            results = self._train_rounds_scan_inner(round_idx, num_rounds)
+        # the chunk is one dispatch; attribute its wall time evenly so the
+        # per-round histogram stays comparable across dispatch modes
+        per_round = (_time.perf_counter() - t0) / num_rounds
+        for _ in range(num_rounds):
+            self._m_round_secs.observe(per_round)
+        return results
+
+    def _train_rounds_scan_inner(
+        self, round_idx: int, num_rounds: int
+    ) -> list[RoundResult]:
         cfg = self.cfg
+        tracer = self.tracer
         from fedrec_tpu.fed.strategies import participation_mask
 
         weights = np.stack([
@@ -803,41 +924,51 @@ class Trainer:
         ])
         table = self._feature_table()
 
-        round_lists: list[list[dict]] = []
-        steps: int | None = None
-        for r in range(round_idx, round_idx + num_rounds):
-            batches: list[dict] = []
-            for local_epoch in range(cfg.fed.local_epochs):
-                epoch_idx = r * cfg.fed.local_epochs + local_epoch
-                batches.extend(
-                    {
-                        "candidates": b.candidates,
-                        "history": b.history,
-                        "labels": b.labels,
-                    }
-                    for b in self.batcher.epoch_batches_sharded(
-                        cfg.fed.num_clients, epoch_idx
+        with tracer.span(
+            "batch_build", kind="round_stack", rounds=num_rounds
+        ):
+            round_lists: list[list[dict]] = []
+            steps: int | None = None
+            for r in range(round_idx, round_idx + num_rounds):
+                batches: list[dict] = []
+                for local_epoch in range(cfg.fed.local_epochs):
+                    epoch_idx = r * cfg.fed.local_epochs + local_epoch
+                    batches.extend(
+                        {
+                            "candidates": b.candidates,
+                            "history": b.history,
+                            "labels": b.labels,
+                        }
+                        for b in self.batcher.epoch_batches_sharded(
+                            cfg.fed.num_clients, epoch_idx
+                        )
                     )
+                if steps is None:
+                    steps = len(batches)
+                elif len(batches) != steps:
+                    # static (rounds, steps) shapes are the contract; a
+                    # varying per-epoch step count cannot stack
+                    raise RuntimeError(
+                        f"rounds-in-jit needs a constant steps-per-round, got "
+                        f"{steps} then {len(batches)}"
+                    )
+                round_lists.append(batches)
+            if not steps:
+                raise ValueError(
+                    "no batches: dataset smaller than num_clients*batch_size"
                 )
-            if steps is None:
-                steps = len(batches)
-            elif len(batches) != steps:
-                # static (rounds, steps) shapes are the contract; a varying
-                # per-epoch step count cannot stack
-                raise RuntimeError(
-                    f"rounds-in-jit needs a constant steps-per-round, got "
-                    f"{steps} then {len(batches)}"
-                )
-            round_lists.append(batches)
-        if not steps:
-            raise ValueError(
-                "no batches: dataset smaller than num_clients*batch_size"
-            )
 
-        stacked = shard_round_batches(self.mesh, stack_rounds(round_lists), cfg)
-        self.state, metrics = self.round_scan(
-            self.state, stacked, table, jnp.asarray(weights)
-        )
+        with tracer.span("h2d", n=num_rounds * steps):
+            stacked = shard_round_batches(
+                self.mesh, stack_rounds(round_lists), cfg
+            )
+        self._m_steps.inc(num_rounds * steps)
+        with tracer.span(
+            "dispatch", kind="round_chunk", rounds=num_rounds, steps=steps
+        ):
+            self.state, metrics = self.round_scan(
+                self.state, stacked, table, jnp.asarray(weights)
+            )
 
         if "unique_overflow" in metrics:
             # (rounds, steps, clients): max over clients (replicated psum
@@ -846,6 +977,7 @@ class Trainer:
                 np.asarray(metrics["unique_overflow"]).max(axis=-1).sum()
             )
             if total > 0:
+                self._m_overflow.inc(total)
                 raise RuntimeError(self._overflow_message(total))
 
         mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
@@ -993,25 +1125,49 @@ class Trainer:
     def run(self) -> list[RoundResult]:
         cfg = self.cfg
         history: list[RoundResult] = []
-        with profile_if(cfg.train.profile):
-            round_idx = self.start_round
-            while round_idx < cfg.fed.rounds:
-                # rounds-in-jit: chunks of up to train.rounds_per_scan
-                # rounds in one dispatch, always breaking at eval/save
-                # cadence boundaries so the host-side bookkeeping below
-                # sees exactly the rounds it would host-driven
-                chunk = self._round_chunk(round_idx)
-                if chunk > 1:
-                    results = self._train_rounds_scan(round_idx, chunk)
-                else:
-                    results = [self.train_round(round_idx)]
-                for result in results:
-                    history.append(result)
-                    self._after_round(result)
-                round_idx += len(results)
-        if self.snapshots is not None:
-            self.snapshots.wait()  # settle async saves before handing back
-        self.logger.finish()
+        try:
+            with profile_if(cfg.train.profile):
+                round_idx = self.start_round
+                while round_idx < cfg.fed.rounds:
+                    # rounds-in-jit: chunks of up to train.rounds_per_scan
+                    # rounds in one dispatch, always breaking at eval/save
+                    # cadence boundaries so the host-side bookkeeping below
+                    # sees exactly the rounds it would host-driven
+                    chunk = self._round_chunk(round_idx)
+                    if chunk > 1:
+                        results = self._train_rounds_scan(round_idx, chunk)
+                    else:
+                        results = [self.train_round(round_idx)]
+                    for result in results:
+                        history.append(result)
+                        self._after_round(result)
+                    round_idx += len(results)
+            if self.snapshots is not None:
+                self.snapshots.wait()  # settle async saves before handing back
+        finally:
+            # artifacts on EVERY exit path: a run that died to a cap
+            # overflow (or any mid-round error) is exactly the run whose
+            # trace/registry state is needed — and the failing round never
+            # reached its _after_round snapshot
+            if self._obs_dir is not None:
+                try:
+                    paths = dump_artifacts(
+                        self._obs_dir, registry=self.registry,
+                        tracer=self.tracer,
+                    )
+                    print(
+                        f"[trainer] obs artifacts: {paths['metrics']} "
+                        f"{paths['trace']} {paths['prometheus']}"
+                    )
+                except Exception as e:  # noqa: BLE001 — never mask the training error
+                    print(f"[trainer] could not write obs artifacts: "
+                          f"{type(e).__name__}: {e}")
+            try:
+                self.logger.finish()
+            except Exception as e:  # noqa: BLE001 — a wandb flush error must
+                # not displace the exception that actually ended training
+                print(f"[trainer] logger.finish failed: "
+                      f"{type(e).__name__}: {e}")
         return history
 
     def _after_round(self, result: RoundResult) -> None:
@@ -1019,7 +1175,16 @@ class Trainer:
         cadence snapshots (+ FedOpt sidecar)."""
         cfg = self.cfg
         round_idx = result.round_idx
+        self._m_rounds.inc()
+        self._m_round_loss.set(result.train_loss)
         log = {"round": round_idx, "training_loss": result.train_loss}
+        if self._eps_schedule is not None:
+            # rounds completed so far INCLUDING resumed ones: the privacy
+            # budget composes over the whole trajectory, not this process's
+            # uptime
+            eps = self._eps_schedule(round_idx + 1)
+            self._m_eps.set(eps)
+            log["privacy.epsilon_spent"] = round(eps, 6)
         if result.val_metrics:
             named = {
                 "validation_loss": result.val_metrics.get("loss"),
@@ -1053,9 +1218,12 @@ class Trainer:
             try:
                 # blocking: the marker must never describe a
                 # snapshot that is still in flight
-                self.best_snapshots.save(
-                    round_idx, self.state, wait=True
-                )
+                with self.tracer.span(
+                    "checkpoint", round=round_idx, kind="best"
+                ):
+                    self.best_snapshots.save(
+                        round_idx, self.state, wait=True
+                    )
                 atomic_write_bytes(
                     self.best_snapshots.directory / "best.json",
                     _json.dumps(
@@ -1080,13 +1248,21 @@ class Trainer:
             # newer than the orbax snapshot it pairs with (a crash
             # between an async save and the sidecar write would
             # resume round-r momentum against round r-k params)
-            self.snapshots.save(
-                round_idx, self.state, wait=self.server_opt is not None
-            )
-            if self.server_opt is not None:
-                from fedrec_tpu.train.checkpoint import atomic_write_bytes
-
-                atomic_write_bytes(
-                    self.snapshots.directory / "server_opt_state.msgpack",
-                    self.server_opt.state_bytes(round_idx),
+            with self.tracer.span(
+                "checkpoint", round=round_idx, kind="cadence"
+            ):
+                self.snapshots.save(
+                    round_idx, self.state, wait=self.server_opt is not None
                 )
+                if self.server_opt is not None:
+                    from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                    atomic_write_bytes(
+                        self.snapshots.directory / "server_opt_state.msgpack",
+                        self.server_opt.state_bytes(round_idx),
+                    )
+        if (
+            self._obs_dir is not None
+            and (round_idx + 1) % max(cfg.obs.snapshot_every, 1) == 0
+        ):
+            self.registry.write_snapshot(self._obs_dir / "metrics.jsonl")

@@ -1,9 +1,22 @@
-"""Metric logging: stdout always, wandb when available and enabled.
+"""Metric logging: the obs registry is the backend; stdout/wandb are exporters.
 
 Mirrors the reference's 6-metric wandb schema (train/valid loss + AUC/MRR/
 NDCG@5/NDCG@10, reference ``client.py:182-189``) without the hardcoded API
 key (``client.py:214`` — a leaked secret we deliberately do not replicate;
 auth comes from the environment).
+
+Every ``log()`` call:
+
+* publishes each numeric metric as a gauge in the process-wide
+  :mod:`fedrec_tpu.obs` registry (so the Prometheus exposition and the
+  registry snapshots see the training schema without extra wiring);
+* writes one JSON line to the stream (and to ``jsonl_path`` when given —
+  the run's event log ``fedrec-obs report`` consumes), FLUSHED, so a
+  killed run keeps every line it printed;
+* stringifies non-float-coercible values in the JSONL record instead of
+  passing them through raw (a dict or ndarray payload used to make the
+  line non-serializable), and sends only the numeric subset to wandb —
+  wandb's silent per-key drop is now an explicit contract.
 """
 
 from __future__ import annotations
@@ -13,6 +26,8 @@ import sys
 import time
 from typing import Any
 
+from fedrec_tpu.obs import get_registry
+
 
 class MetricLogger:
     def __init__(
@@ -21,9 +36,16 @@ class MetricLogger:
         project: str = "fedrec_tpu",
         run_name: str = "run",
         stream=None,
+        jsonl_path: str | None = None,
+        registry=None,
     ):
         self.stream = stream or sys.stdout
         self._t0 = time.time()
+        self._registry = registry or get_registry()
+        self._records = self._registry.counter(
+            "log.records_total", "metric-log records emitted"
+        )
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._wandb = None
         if use_wandb:
             try:
@@ -35,14 +57,45 @@ class MetricLogger:
                 print(f"[logger] wandb unavailable ({e}); stdout only", file=sys.stderr)
 
     def log(self, step: int, metrics: dict[str, Any]) -> None:
-        clean = {
-            k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()
-        }
+        numeric: dict[str, float] = {}
+        clean: dict[str, Any] = {}
+        for k, v in metrics.items():
+            # numeric iff float-coercible by protocol (strings stay strings
+            # even when they look like numbers); a >1-element ndarray has
+            # __float__ but raises — stringify it like any other non-numeric
+            if hasattr(v, "__float__"):
+                try:
+                    f = float(v)
+                except (TypeError, ValueError):
+                    clean[k] = str(v)
+                    continue
+                numeric[k] = f
+                clean[k] = f
+            else:
+                # strings and None are already JSON-native (null stays null —
+                # serving emits real Nones for not-yet-populated percentiles);
+                # everything else is stringified
+                clean[k] = v if isinstance(v, str) or v is None else str(v)
         record = {"step": step, "elapsed_sec": round(time.time() - self._t0, 2), **clean}
-        print(json.dumps(record), file=self.stream)
+        line = json.dumps(record)
+        print(line, file=self.stream, flush=True)
+        if self._jsonl is not None:
+            self._jsonl.write(line + "\n")
+            self._jsonl.flush()
+        # registry backend: the logged schema doubles as gauges, so snapshots
+        # and the Prometheus exposition carry training_loss/valid_auc/... too
+        for k, f in numeric.items():
+            try:
+                self._registry.gauge(k).set(f)
+            except ValueError:
+                pass  # name already registered as a non-gauge — skip, don't crash
+        self._records.inc()
         if self._wandb is not None:
-            self._wandb.log(clean, step=step)
+            self._wandb.log(numeric, step=step)
 
     def finish(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
         if self._wandb is not None:
             self._wandb.finish()

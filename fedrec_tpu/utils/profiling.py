@@ -3,6 +3,13 @@
 The reference has no profiling subsystem (SURVEY.md section 5.1 — only print
 statements and a vestigial counter pair, reference ``model.py:31-32``); here
 a context manager wraps any region in a TensorBoard-compatible trace.
+
+``profile_if`` yields the logdir the trace lands in (None when disabled),
+so callers can report/stamp where the artifact went instead of hardcoding
+the default path a second time.  Host-side round structure goes through
+:mod:`fedrec_tpu.obs.tracing` instead; the Trainer annotates each round
+with ``jax.profiler.StepTraceAnnotation("fed_round", step_num=...)`` so
+the device trace captured here is round-addressable.
 """
 
 from __future__ import annotations
@@ -14,11 +21,17 @@ import jax
 
 @contextlib.contextmanager
 def profile_if(enabled: bool, logdir: str = "/tmp/fedrec_tpu_trace"):
+    """Wrap the block in a ``jax.profiler`` trace when ``enabled``.
+
+    Yields the logdir path (the handle on the written trace) when
+    enabled, None when not — a no-trace region never looks like it
+    produced an artifact.
+    """
     if not enabled:
-        yield
+        yield None
         return
     jax.profiler.start_trace(logdir)
     try:
-        yield
+        yield logdir
     finally:
         jax.profiler.stop_trace()

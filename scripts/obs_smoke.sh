@@ -1,0 +1,87 @@
+#!/bin/bash
+# Observability smoke (the ISSUE-3 acceptance scenario), CPU-only:
+#
+#   1. a 2-round synthetic training run with obs.dir set (+ DP so the
+#      epsilon gauge is live, + prefetch so queue health is live),
+#   2. a short serve_load run with --obs-dir,
+#   3. assert each produced the artifact trio — registry-snapshot JSONL,
+#      a valid Perfetto/Chrome trace with >= 4 distinct span names, a
+#      Prometheus exposition carrying serve p50/p99 + prefetch queue
+#      depth + privacy.epsilon_spent — and that fedrec-obs renders both
+#      into run reports.
+#
+#   scripts/obs_smoke.sh     # or: make obs-smoke
+#
+# Artifacts land under /tmp/fedrec_obs_smoke for inspection.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${OBS_SMOKE_DIR:-/tmp/fedrec_obs_smoke}
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+run() {
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
+}
+
+echo "== [1/3] 2-round CPU training run (DP + prefetch) =="
+run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
+    --synthetic --synthetic-train 512 --synthetic-news 128 \
+    --mode joint --dp-epsilon 10 \
+    --obs-dir "$OUT/train" \
+    --set data.prefetch_batches=2 \
+    --set model.news_dim=32 --set model.num_heads=4 --set model.head_dim=8 \
+    --set model.query_dim=16 --set model.bert_hidden=48 \
+    --set data.max_his_len=10 --set data.max_title_len=12 \
+    --set train.snapshot_dir="$OUT/train_snap" --set train.eval_every=1 \
+    --set train.eval_protocol=sampled > "$OUT/train.log" 2>&1 \
+    || { tail -30 "$OUT/train.log"; exit 1; }
+
+echo "== [2/3] serve_load run =="
+run python benchmarks/serve_load.py --num-news 2000 --his-len 10 \
+    --clients 4 --rate 50 --duration 2 --out obs_smoke_serve_load.json \
+    --obs-dir "$OUT/serve" > "$OUT/serve.log" 2>&1 \
+    || { tail -30 "$OUT/serve.log"; exit 1; }
+rm -f benchmarks/obs_smoke_serve_load.json
+
+echo "== [3/3] artifact assertions =="
+for d in train serve; do
+    for f in metrics.jsonl trace.json prometheus.txt; do
+        [ -s "$OUT/$d/$f" ] || { echo "MISSING $OUT/$d/$f"; exit 1; }
+    done
+done
+
+python - "$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+
+for run in ("train", "serve"):
+    doc = json.load(open(f"{out}/{run}/trace.json"))
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert len(names) >= 4, f"{run}: want >=4 span names, got {sorted(names)}"
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), f"{run}: trace ts not monotonic"
+    snaps = [json.loads(l) for l in open(f"{out}/{run}/metrics.jsonl")
+             if '"registry_snapshot"' in l]
+    assert snaps, f"{run}: no registry snapshot in metrics.jsonl"
+    print(f"  {run}: {len(evs)} events, span names ok: {sorted(names)[:6]}...")
+
+train_prom = open(f"{out}/train/prometheus.txt").read()
+serve_prom = open(f"{out}/serve/prometheus.txt").read()
+for needle, hay, which in (
+    ("privacy.epsilon_spent", train_prom, "train"),
+    ("data_prefetch_queue_depth", train_prom, "train"),
+    ("serve_p50_ms", serve_prom, "serve"),
+    ("serve_p99_ms", serve_prom, "serve"),
+    ("serve_queue_depth", serve_prom, "serve"),
+):
+    assert needle in hay, f"{which} prometheus.txt missing {needle}"
+print("  prometheus expositions carry p50/p99, queue depth, epsilon_spent")
+EOF
+
+echo "== run reports =="
+python -m fedrec_tpu.cli.obs report "$OUT/train"
+python -m fedrec_tpu.cli.obs report "$OUT/serve"
+echo "OBS_SMOKE=PASS"

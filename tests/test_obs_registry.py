@@ -1,0 +1,175 @@
+"""Metrics-registry contracts (`fedrec_tpu.obs.registry`): concurrency,
+histogram bucket-edge semantics, Prometheus exposition validity, snapshot
+round-tripping, and name-conflict fail-fast."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+import pytest
+
+from fedrec_tpu.obs import MetricsRegistry
+from fedrec_tpu.obs.registry import sanitize_prom_name
+
+
+def test_counter_concurrent_increments_are_exact():
+    """N threads x M increments land exactly N*M — the lock is real, not
+    decorative (the prefetcher's stall counters run on a producer thread
+    while snapshots read from the main thread)."""
+    reg = MetricsRegistry()
+    c = reg.counter("t.hits_total")
+    g = reg.gauge("t.level")
+    h = reg.histogram("t.lat_ms", buckets=(1.0, 10.0, 100.0))
+    N, M = 8, 2500
+
+    def work(i):
+        for k in range(M):
+            c.inc()
+            g.set(k)
+            h.observe(float(k % 150))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == N * M
+    cell = h.cell()
+    assert cell["count"] == N * M
+    assert sum(cell["counts"]) == N * M
+
+
+def test_histogram_bucket_edges_are_inclusive():
+    """Prometheus ``le`` semantics: an observation EQUAL to an upper bound
+    counts in that bucket; above every finite bound -> +Inf; negatives ->
+    the first bucket."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t.h", buckets=(1.0, 5.0, 25.0))
+    for v in (1.0, 5.0, 25.0):   # exactly on each edge
+        h.observe(v)
+    h.observe(0.0)               # low edge of the first bucket
+    h.observe(-3.0)              # below zero still counts (first bucket)
+    h.observe(26.0)              # past the last finite bound
+    cell = h.cell()
+    assert cell["counts"] == [3, 1, 1, 1]  # le=1: {1.0, 0.0, -3.0}
+    assert cell["count"] == 6
+    assert cell["sum"] == pytest.approx(1 + 5 + 25 + 0 - 3 + 26)
+
+
+def test_histogram_quantile_estimates_and_empty():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.q", buckets=(10.0, 20.0, 40.0))
+    assert h.quantile(0.5) is None  # no observations yet
+    for _ in range(100):
+        h.observe(15.0)  # all in (10, 20]
+    q50 = h.quantile(0.5)
+    assert 10.0 <= q50 <= 20.0
+    # +Inf bucket clamps to the last finite bound — never invents a value
+    h2 = reg.histogram("t.q2", buckets=(1.0,))
+    h2.observe(100.0)
+    assert h2.quantile(0.99) == 1.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_labels_and_kind_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("t.batches_total", labels=("bucket",))
+    c.inc(bucket=8)
+    c.inc(2, bucket=32)
+    assert c.value(bucket=8) == 1 and c.value(bucket=32) == 2
+    # wrong label set raises
+    with pytest.raises(ValueError):
+        c.inc(size=8)
+    # same name, same kind, same labels: the same instrument back
+    assert reg.counter("t.batches_total", labels=("bucket",)) is c
+    # same name, different kind or labels: fail fast
+    with pytest.raises(ValueError):
+        reg.gauge("t.batches_total")
+    with pytest.raises(ValueError):
+        reg.counter("t.batches_total", labels=("other",))
+    # counters are monotonic
+    with pytest.raises(ValueError):
+        c.inc(-1, bucket=8)
+    # bucket layout is part of a histogram's identity
+    reg.histogram("t.lat", buckets=(1.0, 5.0))
+    assert reg.histogram("t.lat", buckets=(1.0, 5.0)) is reg.get("t.lat")
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("t.lat", buckets=(1.0, 5.0, 25.0))
+
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9].*$"
+)
+
+
+def test_prometheus_exposition_is_well_formed():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests_total", "requests").inc(5)
+    reg.gauge("privacy.epsilon_spent", "spent budget").set(1.25)
+    h = reg.histogram("serve.latency_ms", "latency", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(3.0)
+    h.observe(99.0)
+    b = reg.counter("serve.batches_total", labels=("bucket",))
+    b.inc(bucket='we"ird\nname')  # label escaping
+    text = reg.to_prometheus()
+    lines = text.strip().splitlines()
+    for line in lines:
+        assert line.startswith("# ") or _SAMPLE_LINE.match(line), line
+    # dotted internal names survive in HELP, sanitized in samples
+    assert "# HELP privacy_epsilon_spent privacy.epsilon_spent" in text
+    assert "privacy_epsilon_spent 1.25" in text
+    # histogram: cumulative buckets + +Inf + sum/count
+    assert 'serve_latency_ms_bucket{le="1.0"} 1' in text
+    assert 'serve_latency_ms_bucket{le="10.0"} 2' in text
+    assert 'serve_latency_ms_bucket{le="+Inf"} 3' in text
+    assert "serve_latency_ms_count 3" in text
+    # escaped label value, no raw newline in any sample line
+    assert '\\n' in text and 'we\\"ird' in text
+
+
+def test_snapshot_is_json_and_collectors_refresh():
+    reg = MetricsRegistry()
+    g = reg.gauge("t.derived")
+    calls = []
+    reg.register_collector(lambda: (calls.append(1), g.set(len(calls)))[0])
+    snap1 = reg.snapshot()
+    snap2 = json.loads(json.dumps(reg.snapshot()))  # JSON round-trip
+    assert snap1["kind"] == snap2["kind"] == "registry_snapshot"
+    # the collector ran once per snapshot and the gauge tracked it
+    assert snap2["metrics"]["t.derived"]["values"][0]["value"] == 2
+
+    # a crashing collector is contained
+    def boom():
+        raise RuntimeError("nope")
+
+    reg.register_collector(boom)
+    reg.snapshot()  # no raise
+
+    # unregister stops refresh
+    assert len(calls) == 3
+    for fn in list(reg._collectors):
+        reg.unregister_collector(fn)
+    reg.snapshot()
+    assert len(calls) == 3
+
+
+def test_write_snapshot_appends_jsonl(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("a.b_total").inc()
+    p = tmp_path / "metrics.jsonl"
+    reg.write_snapshot(p)
+    reg.write_snapshot(p)
+    lines = [json.loads(l) for l in p.read_text().splitlines()]
+    assert len(lines) == 2
+    assert all(l["kind"] == "registry_snapshot" for l in lines)
+    assert lines[0]["metrics"]["a.b_total"]["values"][0]["value"] == 1
+
+
+def test_sanitize_prom_name():
+    assert sanitize_prom_name("serve.p50_ms") == "serve_p50_ms"
+    assert sanitize_prom_name("val_ndcg@5") == "val_ndcg_5"
+    assert sanitize_prom_name("5xx") == "_5xx"

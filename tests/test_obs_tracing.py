@@ -1,0 +1,125 @@
+"""Span-tracer contracts (`fedrec_tpu.obs.tracing`): Chrome-trace/Perfetto
+schema validity (loadable event array, monotonic ts), span nesting,
+cross-clock add_span, the capacity bound, and error annotation."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from fedrec_tpu.obs import Tracer
+
+
+def test_saved_trace_is_valid_chrome_json(tmp_path):
+    tr = Tracer()
+    with tr.span("outer", step_num=0):
+        with tr.span("inner", kind="work"):
+            time.sleep(0.002)
+    tr.instant("marker", note="x")
+    path = tmp_path / "trace.json"
+    tr.save(path)
+
+    doc = json.loads(path.read_text())  # loadable JSON object
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and len(evs) == 3
+    for e in evs:
+        assert isinstance(e["name"], str)
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # exported ts sequence is monotonic non-decreasing (sorted on save)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    assert doc["otherData"]["dropped_events"] == 0
+
+
+def test_span_nesting_intervals():
+    """An inner span's [ts, ts+dur] lies within its enclosing span's —
+    the property the Trainer round-span test leans on."""
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            time.sleep(0.001)
+    inner, outer = tr.events()  # inner closes first
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_add_span_places_duration_before_end():
+    """add_span carries a duration measured on a FOREIGN clock; only the
+    end lands on the tracer clock, so ts = end - dur exactly."""
+    tr = Tracer()
+    end = tr.now()
+    tr.add_span("waited", dur_s=0.5, end=end, bucket=8)
+    (e,) = tr.events()
+    assert e["dur"] == pytest.approx(0.5e6)
+    assert e["ts"] == pytest.approx((end - tr._t0) * 1e6 - 0.5e6, rel=1e-6)
+    assert e["args"]["bucket"] == 8
+    # negative durations clamp to zero rather than drawing backwards
+    tr.add_span("clamped", dur_s=-1.0)
+    assert tr.events()[-1]["dur"] == 0.0
+
+
+def test_capacity_bound_keeps_head_and_counts_drops(tmp_path):
+    tr = Tracer(capacity=10)
+    for i in range(25):
+        tr.add_span(f"s{i}", dur_s=0.0)
+    assert len(tr.events()) == 10
+    assert tr.dropped == 15
+    assert [e["name"] for e in tr.events()] == [f"s{i}" for i in range(10)]
+    doc = tr.save(tmp_path / "t.json")
+    assert doc["otherData"]["dropped_events"] == 15
+    tr.reset()
+    assert tr.events() == [] and tr.dropped == 0
+
+
+def test_disabled_tracer_records_nothing():
+    """enabled=False is the no-spans switch for processes that will never
+    save a trace (fedrec-serve without --obs-dir): no events, no drop
+    accounting, and re-enabling resumes recording."""
+    tr = Tracer(capacity=10)
+    tr.enabled = False
+    with tr.span("ignored"):
+        tr.add_span("also_ignored", dur_s=0.1)
+        tr.instant("nope")
+    assert tr.events() == [] and tr.dropped == 0
+    tr.enabled = True
+    with tr.span("kept"):
+        pass
+    assert [e["name"] for e in tr.events()] == ["kept"]
+
+
+def test_span_records_error_and_reraises():
+    tr = Tracer()
+    with pytest.raises(KeyError):
+        with tr.span("will_fail"):
+            raise KeyError("boom")
+    (e,) = tr.events()
+    assert e["args"]["error"] == "KeyError"
+
+
+def test_threaded_spans_all_recorded():
+    tr = Tracer()
+
+    barrier = threading.Barrier(4)  # overlap lifetimes: distinct idents
+
+    def work(i):
+        barrier.wait()
+        for _ in range(50):
+            with tr.span("w", i=i):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr.events()) == 200
+    # distinct tids show up (thread lanes in Perfetto)
+    assert len({e["tid"] for e in tr.events()}) >= 2

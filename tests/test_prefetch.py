@@ -129,3 +129,33 @@ def test_prefetch_threads_do_not_accumulate():
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.01)
     assert threading.active_count() <= before
+
+
+def test_prefetch_queue_health_telemetry():
+    """Queue-depth gauge + producer/consumer stall counters land in the
+    registry: a slow CONSUMER piles up producer stalls (queue full — the
+    good case: the device is the bottleneck); a slow PRODUCER piles up
+    consumer stalls (the dispatch gap is back)."""
+    from fedrec_tpu.obs import MetricsRegistry
+
+    # slow consumer: producer fills depth-2 queue and must wait
+    reg = MetricsRegistry()
+    pf = Prefetcher(range(20), depth=2, registry=reg)
+    out = []
+    for x in pf:
+        time.sleep(0.02)
+        out.append(x)
+    assert out == list(range(20))
+    assert reg.counter("data.prefetch.producer_stall_total").value() > 0
+    assert reg.counter("data.prefetch.items_total").value() == 20
+    assert reg.gauge("data.prefetch.queue_depth").value() is not None
+
+    # slow producer: consumer finds the queue empty
+    def slow_source():
+        for i in range(5):
+            time.sleep(0.02)
+            yield i
+
+    reg2 = MetricsRegistry()
+    assert list(Prefetcher(slow_source(), depth=2, registry=reg2)) == list(range(5))
+    assert reg2.counter("data.prefetch.consumer_stall_total").value() > 0

@@ -36,6 +36,50 @@ def test_metric_logger_schema():
     json.dumps(lines)  # everything serializable
 
 
+def test_metric_logger_stringifies_non_numerics_and_flushes(tmp_path):
+    """Non-float-coercible values land in the JSONL record as STRINGS (a
+    dict/ndarray payload used to produce an unserializable or lossy line),
+    the stream is flushed per line, and every numeric metric doubles as a
+    registry gauge (the obs backend)."""
+    from fedrec_tpu.obs import MetricsRegistry
+
+    class FlushCounting(io.StringIO):
+        flushes = 0
+
+        def flush(self):
+            type(self).flushes += 1
+            super().flush()
+
+    reg = MetricsRegistry()
+    buf = FlushCounting()
+    jsonl = tmp_path / "run.jsonl"
+    logger = MetricLogger(stream=buf, jsonl_path=str(jsonl), registry=reg)
+    logger.log(0, {
+        "training_loss": 1.25,
+        "numeric_string": "1.5",              # strings STAY strings
+        "mode": "head",
+        "payload": {"nested": [1, 2]},        # stringified, not dropped
+        "arr": np.arange(3),                  # >1-element ndarray: stringified
+        "p50_ms": None,                       # JSON null, NOT the string "None"
+    })
+    assert FlushCounting.flushes >= 1
+    logger.finish()
+
+    rec = json.loads(buf.getvalue().splitlines()[0])
+    assert rec["training_loss"] == 1.25
+    assert rec["numeric_string"] == "1.5"
+    assert rec["mode"] == "head"
+    assert isinstance(rec["payload"], str) and "nested" in rec["payload"]
+    assert isinstance(rec["arr"], str)
+    assert rec["p50_ms"] is None  # serving's pre-traffic percentiles stay null
+    # the sidecar event log got the same line, already flushed to disk
+    assert json.loads(jsonl.read_text().splitlines()[0]) == rec
+    # registry backend: numerics became gauges, non-numerics did not
+    assert reg.gauge("training_loss").value() == 1.25
+    assert "mode" not in reg.names()
+    assert reg.counter("log.records_total").value() == 1
+
+
 def test_metric_logger_wandb_degrades_to_stdout(monkeypatch):
     """No wandb auth in this environment: use_wandb=True must not raise and
     must keep stdout logging working (the reference instead hardcoded an API
@@ -50,15 +94,18 @@ def test_metric_logger_wandb_degrades_to_stdout(monkeypatch):
 
 
 def test_profile_if_writes_trace(tmp_path):
-    """enabled=True wraps the region in a jax.profiler trace and leaves a
-    TensorBoard-compatible artifact; enabled=False is a no-op."""
-    with profile_if(False, str(tmp_path / "off")):
+    """enabled=True wraps the region in a jax.profiler trace, YIELDS the
+    logdir (the caller's handle on the artifact), and leaves a
+    TensorBoard-compatible file; enabled=False is a no-op yielding None."""
+    with profile_if(False, str(tmp_path / "off")) as where:
         jnp.ones((8, 8)).sum().block_until_ready()
+    assert where is None
     assert not (tmp_path / "off").exists()
 
     logdir = tmp_path / "on"
-    with profile_if(True, str(logdir)):
+    with profile_if(True, str(logdir)) as where:
         (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+    assert where == str(logdir)
     traces = list(logdir.rglob("*.xplane.pb"))
     assert traces, f"no trace written under {logdir}"
 
