@@ -1,32 +1,46 @@
-"""``fedrec-obs`` — render a run's observability artifacts.
+"""``fedrec-obs`` — render and replay a run's observability artifacts.
 
 Consumes the artifact trio every instrumented entry point writes
 (Trainer with ``obs.dir``, ``fedrec-serve --obs-dir``,
 ``benchmarks/serve_load.py --obs-dir``):
 
 * ``metrics.jsonl``   — MetricLogger records + registry snapshots
+  (plus ``metrics.jsonl.1`` when ``obs.jsonl_max_mb`` rotated the log;
+  rotated files are read first, in order)
 * ``trace.json``      — Chrome-trace/Perfetto host spans
 * ``prometheus.txt``  — final text exposition
+
+plus the flight-recorder dump (``flightrec/``) the training-health
+sentry writes on a non-finite/divergence trigger.
 
 Subcommands:
 
   fedrec-obs report <dir | metrics.jsonl> [--trace trace.json] [--json]
       One-page run report: round throughput, loss trajectory, serve
-      p50/p99, prefetch stalls, epsilon-spent trajectory, cap-overflow
-      counts, host-span summary.
+      p50/p99, prefetch stalls, epsilon-spent trajectory, health +
+      recompile counters, cap-overflow counts, host-span summary.
 
   fedrec-obs prom <dir | metrics.jsonl>
       Re-render the LAST registry snapshot in the event log as a
       Prometheus text exposition (for a run that predates, or lost, its
       prometheus.txt).
 
-Imports no JAX — usable on any box the artifacts were copied to.
+  fedrec-obs replay <dir | flightrec dir> [--max-steps N] [--json]
+      Re-execute the flight-recorder dump's recorded steps on CPU from
+      the dumped chunk-entry state — deterministically confirming (and
+      bisecting to) the step that went non-finite.  Exit 0 when the
+      dump's trigger is reproduced, 1 when it is not.
+
+``report``/``prom`` import no JAX — usable on any box the artifacts were
+copied to; ``replay`` imports JAX lazily (and pins ``JAX_PLATFORMS=cpu``
+unless the environment already chose a platform).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -37,6 +51,11 @@ from fedrec_tpu.obs.report import (
     load_trace,
     render_text,
 )
+
+
+def _fail(msg: str) -> int:
+    print(f"fedrec-obs: {msg}", file=sys.stderr)
+    return 2
 
 
 def _resolve(path_arg: str) -> tuple[Path, Path | None]:
@@ -50,15 +69,41 @@ def _resolve(path_arg: str) -> tuple[Path, Path | None]:
     return p, None
 
 
+def _load_event_log(metrics_path: Path):
+    """load_jsonl with operator-grade failure messages instead of
+    tracebacks; returns (records, snapshots) or an int exit code."""
+    if not metrics_path.exists() and not Path(str(metrics_path) + ".1").exists():
+        parent = metrics_path.parent
+        hint = (
+            " (the directory does not exist — check the obs dir path)"
+            if not parent.exists()
+            else " (directory exists but holds no event log — was the run "
+                 "started with obs.dir / --obs-dir?)"
+        )
+        return _fail(f"no event log at {metrics_path}{hint}")
+    try:
+        return load_jsonl(metrics_path)
+    except OSError as e:
+        return _fail(f"cannot read {metrics_path}: {e}")
+
+
 def _cmd_report(args) -> int:
     metrics_path, trace_path = _resolve(args.path)
     if args.trace:
         trace_path = Path(args.trace)
-    if not metrics_path.exists():
-        print(f"fedrec-obs: no event log at {metrics_path}", file=sys.stderr)
-        return 2
-    records, snapshots = load_jsonl(metrics_path)
-    trace_events = load_trace(trace_path) if trace_path else None
+        if not trace_path.exists():
+            return _fail(f"no trace file at {trace_path}")
+    loaded = _load_event_log(metrics_path)
+    if isinstance(loaded, int):
+        return loaded
+    records, snapshots = loaded
+    trace_events = None
+    if trace_path:
+        try:
+            trace_events = load_trace(trace_path)
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            print(f"fedrec-obs: skipping unreadable trace {trace_path}: {e}",
+                  file=sys.stderr)
     report = build_report(records, snapshots, trace_events)
     if args.json:
         print(json.dumps(report, indent=2))
@@ -69,17 +114,200 @@ def _cmd_report(args) -> int:
 
 def _cmd_prom(args) -> int:
     metrics_path, _ = _resolve(args.path)
-    if not metrics_path.exists():
-        print(f"fedrec-obs: no event log at {metrics_path}", file=sys.stderr)
-        return 2
-    _, snapshots = load_jsonl(metrics_path)
+    loaded = _load_event_log(metrics_path)
+    if isinstance(loaded, int):
+        return loaded
+    _, snapshots = loaded
     if not snapshots:
-        print(f"fedrec-obs: no registry snapshot in {metrics_path}",
-              file=sys.stderr)
-        return 2
+        return _fail(
+            f"no registry snapshot in {metrics_path} (the run may have "
+            "died before its first obs.snapshot_every round)"
+        )
     # the SAME renderer the live {"cmd": "prometheus"} endpoint uses —
     # offline output cannot drift from the wire exposition
     print(snapshot_to_prometheus(snapshots[-1]), end="")
+    return 0
+
+
+# ------------------------------------------------------------------ replay
+def _resolve_flightrec(path_arg: str) -> Path | None:
+    """obs dir / flightrec dir / manifest.json path -> flightrec dir."""
+    p = Path(path_arg)
+    if p.name == "manifest.json":
+        p = p.parent
+    if (p / "manifest.json").exists():
+        return p
+    if (p / "flightrec" / "manifest.json").exists():
+        return p / "flightrec"
+    return None
+
+
+def _cmd_replay(args) -> int:
+    flight_dir = _resolve_flightrec(args.path)
+    if flight_dir is None:
+        return _fail(
+            f"no flight-recorder dump under {args.path} — expected "
+            "<obs.dir>/flightrec/manifest.json (dumps are written when the "
+            "health sentry trips with obs.dir set and "
+            "obs.health.flight_recorder on)"
+        )
+    try:
+        manifest = json.loads((flight_dir / "manifest.json").read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return _fail(f"unreadable manifest at {flight_dir}/manifest.json: {e}")
+    if manifest.get("kind") != "flight_recorder_dump":
+        return _fail(f"{flight_dir}/manifest.json is not a flight-recorder dump")
+    if not manifest.get("records"):
+        return _fail(
+            "the dump holds no batch records (the trigger fired before any "
+            "step was recorded); nothing to replay"
+        )
+    if manifest.get("state_file") is None:
+        return _fail("the dump holds no state checkpoint; cannot replay")
+    if manifest.get("table_file") is None:
+        return _fail(
+            "the dump omitted the feature table "
+            f"(skipped at {manifest.get('table_skipped_mb', '?')} MB — raise "
+            "obs.health.dump_table_max_mb); cannot replay"
+        )
+
+    # replay runs on CPU wherever the operator is, unless they chose
+    # a platform explicitly — set BEFORE the first jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+    from flax import serialization
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.fed.strategies import get_strategy
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.parallel.mesh import client_mesh, shard_fed_batch
+    from fedrec_tpu.train.state import init_client_state, replicate_state
+    from fedrec_tpu.train.step import build_fed_train_step, build_param_sync
+
+    cfg = ExperimentConfig.from_dict(manifest["config"])
+    if cfg.fed.seq_shards > 1:
+        return _fail(
+            "the dump was recorded with fed.seq_shards > 1; sequence-"
+            "parallel steps need a multi-device mesh and cannot replay on "
+            "one CPU device"
+        )
+    # replay is per-batch, host-driven, file-free — neutralize every knob
+    # that would change dispatch shape or write artifacts
+    cfg.train.rounds_per_scan = 1
+    cfg.train.scan_steps = 1
+    cfg.train.donate_batch = False
+    cfg.data.prefetch_batches = 0
+    cfg.obs.dir = ""
+    cfg.obs.health.sentry = True  # the sentinel IS the replay's verdict
+
+    # one CPU device hosting the whole client cohort: cohort vmapping makes
+    # the collective math identical to the original packing (train.step)
+    mesh = client_mesh(cfg.fed.num_clients, cfg.fed.mesh_axis, max_devices=1)
+    model = NewsRecommender(cfg.model)
+    strategy = get_strategy(cfg.fed.strategy)
+    template = replicate_state(
+        init_client_state(
+            model, cfg, jax.random.PRNGKey(0),
+            int(manifest["num_news"]), int(manifest["title_len"]),
+        ),
+        cfg.fed.num_clients,
+        jax.random.PRNGKey(1),
+    )
+    try:
+        state = serialization.from_bytes(
+            template, (flight_dir / manifest["state_file"]).read_bytes()
+        )
+    except (OSError, ValueError) as e:
+        return _fail(f"cannot restore the dumped state: {e}")
+    table = np.load(flight_dir / manifest["table_file"])
+
+    step = build_fed_train_step(
+        model, cfg, strategy, mesh, mode=manifest.get("mode") or None
+    )
+    sync = (
+        build_param_sync(cfg, mesh, strategy)
+        if strategy.sync_params_every_round
+        else None
+    )
+    weights = {int(k): np.asarray(v) for k, v in manifest.get("weights", {}).items()}
+
+    records = sorted(manifest["records"], key=lambda r: (r["round"], r["step"]))
+    trigger = manifest.get("trigger", {})
+    max_steps = args.max_steps or len(records)
+    out_rows: list[dict] = []
+    first_bad: dict | None = None
+    prev_round = records[0]["round"]
+    for i, rec in enumerate(records[:max_steps]):
+        if rec["round"] != prev_round:
+            if sync is not None and prev_round in weights:
+                # re-apply the recorded round-end participation sync so a
+                # chunk-spanning dump replays the exact trajectory
+                state = sync(state, np.asarray(weights[prev_round]))
+            prev_round = rec["round"]
+        try:
+            batch = dict(np.load(flight_dir / rec["file"]))
+        except OSError as e:
+            return _fail(f"cannot read batch record {rec['file']}: {e}")
+        state, metrics = step(state, shard_fed_batch(mesh, batch, cfg), table)
+        row = {
+            "round": rec["round"],
+            "step": rec["step"],
+            "loss": float(np.asarray(metrics["mean_loss"]).reshape(-1)[0]),
+            "grad_norm_max": float(np.max(np.asarray(metrics["health.grad_norm"]))),
+            "update_norm_max": float(
+                np.max(np.asarray(metrics["health.update_norm"]))
+            ),
+            "param_norm_max": float(
+                np.max(np.asarray(metrics["health.param_norm"]))
+            ),
+            "nonfinite": int(np.asarray(metrics["health.nonfinite"]).sum()),
+        }
+        out_rows.append(row)
+        if not args.json:
+            print(
+                f"round {row['round']} step {row['step']}: "
+                f"loss={row['loss']:.6g} grad={row['grad_norm_max']:.4g} "
+                f"update={row['update_norm_max']:.4g} "
+                f"param={row['param_norm_max']:.4g} "
+                f"nonfinite={row['nonfinite']}"
+            )
+        if row["nonfinite"] > 0:
+            first_bad = row
+            break
+
+    reproduced = first_bad is not None
+    verdict = {
+        "trigger": trigger,
+        "steps_replayed": len(out_rows),
+        "reproduced_nonfinite": reproduced,
+        "first_nonfinite": first_bad,
+        "rows": out_rows,
+    }
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    elif reproduced:
+        print(
+            f"REPRODUCED: non-finite step at round {first_bad['round']} "
+            f"step {first_bad['step']} (trigger was "
+            f"{trigger.get('kind')} at round {trigger.get('round')} "
+            f"step {trigger.get('step')})"
+        )
+    elif trigger.get("kind") == "nonfinite":
+        print(
+            "NOT REPRODUCED: no replayed step went non-finite — platform "
+            "numerics may differ from the recording host, or the ring "
+            "dropped the poisoning step (ring_complete="
+            f"{manifest.get('ring_complete')})"
+        )
+    else:
+        print(
+            f"no non-finite step (trigger was {trigger.get('kind')!r}); "
+            "the norm trajectory above is the evidence"
+        )
+    if trigger.get("kind") == "nonfinite":
+        return 0 if reproduced else 1
     return 0
 
 
@@ -97,6 +325,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     prom.add_argument("path", help="obs dir or metrics.jsonl path")
     prom.set_defaults(fn=_cmd_prom)
+    rp = sub.add_parser(
+        "replay",
+        help="re-execute a flight-recorder dump on CPU to confirm/bisect",
+    )
+    rp.add_argument("path", help="obs dir, flightrec dir, or manifest.json")
+    rp.add_argument("--max-steps", type=int, default=0,
+                    help="replay at most N recorded steps (0 = all)")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable verdict")
+    rp.set_defaults(fn=_cmd_replay)
     return p
 
 

@@ -165,12 +165,12 @@ def main(argv: list[str] | None = None) -> int:
 
         _Path(args.obs_dir).mkdir(parents=True, exist_ok=True)
         jsonl = str(_Path(args.obs_dir) / "metrics.jsonl")
-    logger = MetricLogger(jsonl_path=jsonl)
+    logger = MetricLogger(jsonl_path=jsonl, jsonl_max_mb=cfg.obs.jsonl_max_mb)
     try:
         asyncio.run(serve_forever(
             service, host=args.host, port=args.port,
             metrics_every_s=args.metrics_every, logger=logger,
-            obs_dir=args.obs_dir,
+            obs_dir=args.obs_dir, jsonl_max_mb=cfg.obs.jsonl_max_mb,
         ))
     except KeyboardInterrupt:
         print("[serve] interrupted; shutting down", file=sys.stderr)

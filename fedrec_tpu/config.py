@@ -217,6 +217,51 @@ class PrivacyConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Training-health flight recorder (fedrec_tpu.obs.health/device).
+
+    ``sentry`` turns on the in-graph numeric sentry: the jitted train step
+    returns a compact per-client health vector (grad/update/param global
+    norms + a non-finite flag, DP clip-rate under dpsgd) that the host
+    fetches asynchronously with the round's losses.  On a non-finite
+    sentinel (or the optional loss-spike predicate) the flight recorder
+    dumps the offending batch, a params/opt-state checkpoint, the registry
+    snapshot, and a replay manifest into ``obs.dir/flightrec/`` —
+    ``fedrec-obs replay`` re-executes that exact step on CPU.
+    """
+
+    sentry: bool = True                # in-graph health vector in step metrics
+    abort_on_nonfinite: bool = True    # raise TrainingHealthError after dump
+    flight_recorder: bool = True       # keep the batch ring + dump (needs obs.dir)
+    ring_size: int = 16                # last-N (batch, metadata) records kept
+    dump_policy: str = "first"         # "first" = one dump per TRIGGER KIND | "all"
+    # keep a host copy of the full client state at every round/chunk entry
+    # (what replay starts from). The copy is a blocking device->host
+    # transfer of params + optimizer state each round — negligible in
+    # simulation, but at large model x cohort scale it is the flight
+    # recorder's dominant cost; turn it off to keep batch-ring forensics
+    # (dumps then have no state checkpoint and cannot replay).
+    snapshot_state: bool = True
+    # loss-spike divergence predicate: trigger a dump (no abort) when a
+    # round's mean loss exceeds spike_factor * mean(trailing spike_window
+    # round losses). 0 = off.
+    spike_factor: float = 0.0
+    spike_window: int = 8
+    # outlier-client flag: a client whose round-mean update-norm exceeds
+    # outlier_k * cohort median is counted/logged (poisoning/divergence
+    # triage). 0 = off.
+    outlier_k: float = 3.0
+    # the replay dump includes the feature table (token states / news-vec
+    # table) up to this many MB; larger tables are skipped and noted in
+    # the manifest (replay then needs the table re-supplied).
+    dump_table_max_mb: int = 512
+    # recompile watchdog: warn (registry counter + stderr) when this many
+    # XLA backend compiles land within storm_window_s seconds.
+    storm_threshold: int = 5
+    storm_window_s: float = 60.0
+
+
+@dataclass
 class ObsConfig:
     """Unified telemetry (fedrec_tpu.obs): registry snapshots + host spans.
 
@@ -230,6 +275,13 @@ class ObsConfig:
     dir: str = ""                      # "" = no files written
     snapshot_every: int = 1            # rounds between registry snapshots
     trace_capacity: int = 200_000      # host-span ring bound (earliest kept)
+    # size-based rotation for metrics.jsonl: when the event log exceeds
+    # this many MB it is renamed to metrics.jsonl.1 (one level kept) and a
+    # fresh file continues — a long serve/train run cannot fill the disk.
+    # Readers (fedrec-obs, load_jsonl) consume rotated files in order.
+    # 0 = unbounded.
+    jsonl_max_mb: float = 0.0
+    health: HealthConfig = field(default_factory=HealthConfig)
 
 
 @dataclass
@@ -306,31 +358,50 @@ class ExperimentConfig:
             section = getattr(cfg, section_name, None)
             if section is None or not dataclasses.is_dataclass(section):
                 raise KeyError(f"unknown config section: {section_name!r}")
-            for k, v in section_val.items():
-                if not hasattr(section, k):
-                    raise KeyError(f"unknown config key: {section_name}.{k}")
-                setattr(section, k, v)
+            _merge_dataclass(section, section_val, section_name)
         return cfg
 
     # ------------------------------------------------------- cli overrides
     def apply_overrides(self, overrides: list[str]) -> "ExperimentConfig":
-        """Apply ``section.key=value`` strings (e.g. ``fed.num_clients=32``)."""
+        """Apply ``section.key=value`` strings (e.g. ``fed.num_clients=32``).
+        Paths may descend into nested sections (``obs.health.sentry=0``)."""
         for item in overrides:
             if "=" not in item:
                 raise ValueError(f"override must be section.key=value, got {item!r}")
             path, raw = item.split("=", 1)
             parts = path.split(".")
-            if len(parts) != 2:
+            if len(parts) < 2:
                 raise ValueError(f"override path must be section.key, got {path!r}")
-            section_name, key = parts
-            section = getattr(self, section_name, None)
-            if section is None or not dataclasses.is_dataclass(section):
-                raise KeyError(f"unknown config section: {section_name!r}")
+            section: Any = self
+            for part in parts[:-1]:
+                section = getattr(section, part, None)
+                if section is None or not dataclasses.is_dataclass(section):
+                    raise KeyError(f"unknown config section: {path!r}")
+            key = parts[-1]
             if not hasattr(section, key):
                 raise KeyError(f"unknown config key: {path!r}")
             current = getattr(section, key)
+            if dataclasses.is_dataclass(current):
+                raise KeyError(
+                    f"config path {path!r} names a section, not a key; "
+                    f"set one of its fields ({path}.<key>=...)"
+                )
             setattr(section, key, _coerce(raw, type(current)))
         return self
+
+
+def _merge_dataclass(section: Any, values: dict[str, Any], path: str) -> None:
+    """Set ``values`` onto a (possibly nested) config dataclass — the
+    recursion behind ``from_dict``, so nested sections like ``obs.health``
+    round-trip through to_dict/from_dict like every flat one."""
+    for k, v in values.items():
+        if not hasattr(section, k):
+            raise KeyError(f"unknown config key: {path}.{k}")
+        current = getattr(section, k)
+        if dataclasses.is_dataclass(current) and isinstance(v, dict):
+            _merge_dataclass(current, v, f"{path}.{k}")
+        else:
+            setattr(section, k, v)
 
 
 def _coerce(raw: str, ty: type) -> Any:

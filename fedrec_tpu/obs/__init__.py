@@ -15,8 +15,15 @@ Three pieces, one namespace:
   -> one run report; Prometheus text exposition via
   ``MetricsRegistry.to_prometheus`` (served by the serving admin
   protocol's ``{"cmd": "prometheus"}`` and the ``fedrec-obs prom`` CLI).
+* :mod:`fedrec_tpu.obs.health` — training-health monitor + flight
+  recorder: digests the in-graph numeric sentry's per-client health
+  vectors, flags outlier clients, and dumps (batch, state, manifest)
+  forensics on non-finite/divergence triggers (``fedrec-obs replay``).
+* :mod:`fedrec_tpu.obs.device` — device-layer watchdogs: XLA recompile
+  accounting with shape provenance and round-boundary HBM gauges.
 
-The package imports no JAX — serving and CLI paths pull it in cheaply.
+The package imports no JAX at module level — serving and CLI paths pull
+it in cheaply (health/device import jax lazily inside functions).
 Metric name catalogue and operator how-to: ``docs/OBSERVABILITY.md``.
 """
 
@@ -36,16 +43,31 @@ from fedrec_tpu.obs.report import (
     load_jsonl,
     load_trace,
     render_text,
+    rotate_jsonl,
 )
 from fedrec_tpu.obs.tracing import Tracer, get_tracer, set_tracer
+from fedrec_tpu.obs.health import (
+    FlightRecorder,
+    HealthMonitor,
+    TrainingHealthError,
+)
+from fedrec_tpu.obs.device import (
+    CompileWatchdog,
+    sample_device_memory,
+    set_active_watchdog,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "CompileWatchdog",
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
     "Tracer",
+    "TrainingHealthError",
     "build_report",
     "dump_artifacts",
     "get_registry",
@@ -53,7 +75,10 @@ __all__ = [
     "load_jsonl",
     "load_trace",
     "render_text",
+    "rotate_jsonl",
+    "sample_device_memory",
     "sanitize_prom_name",
+    "set_active_watchdog",
     "set_registry",
     "set_tracer",
 ]

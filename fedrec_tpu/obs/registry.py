@@ -189,6 +189,30 @@ class Histogram(_Metric):
             cell.sum += value
             cell.count += 1
 
+    def merge_counts(
+        self, counts: Sequence[int], sum: float, count: int, **labels
+    ) -> None:
+        """Bulk-add precomputed per-bucket counts (+Inf bucket LAST, so
+        ``len(counts) == len(buckets) + 1``) under ONE lock acquire — the
+        vectorized observe path for callers that digest whole arrays at
+        once (obs.health publishes a round's (steps × clients) cells per
+        call; a Python-level observe() loop there costs milliseconds on
+        the round-critical path)."""
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram {self.name!r} expects {len(self.buckets) + 1} "
+                f"bucket counts (+Inf last), got {len(counts)}"
+            )
+        key = self._key(labels)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell(len(self.buckets))
+            for i, c in enumerate(counts):
+                cell.counts[i] += int(c)
+            cell.sum += float(sum)
+            cell.count += int(count)
+
     def quantile(self, q: float, **labels) -> float | None:
         """Linear-interpolation estimate of the q-quantile (0 <= q <= 1).
         None before any observation.  Values in the +Inf bucket clamp to

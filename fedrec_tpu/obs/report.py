@@ -21,30 +21,59 @@ cap-overflow counts, span summary) and ``render_text`` prints it — the
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any
 
 
+def rotate_jsonl(path, max_mb: float) -> bool:
+    """Size-based rotation for a JSONL event log: when ``path`` exceeds
+    ``max_mb`` MB it is renamed to ``<path>.1`` (replacing any previous
+    rotation — one level kept, so the log is bounded at ~2×max_mb) and the
+    next append starts a fresh file.  ``max_mb <= 0`` disables.  Returns
+    True when a rotation happened.  Writers call this BEFORE appending;
+    :func:`load_jsonl` reads the rotated file first, so record order is
+    preserved across the boundary."""
+    if not max_mb or max_mb <= 0:
+        return False
+    p = Path(path)
+    try:
+        if p.stat().st_size < max_mb * 1e6:
+            return False
+        os.replace(p, Path(str(p) + ".1"))
+        return True
+    except OSError:
+        return False
+
+
 def load_jsonl(path) -> tuple[list[dict], list[dict]]:
-    """Split a metrics JSONL file into (metric_log_records, snapshots).
-    Unparseable lines are skipped (a crashed writer may leave a torn tail)."""
+    """Split a metrics JSONL event log into (metric_log_records, snapshots).
+    Unparseable lines are skipped (a crashed writer may leave a torn tail).
+    A rotated sibling (``<path>.1``, see :func:`rotate_jsonl`) is read
+    FIRST so records come back in write order across the rotation."""
     records: list[dict] = []
     snapshots: list[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if not isinstance(obj, dict):
-                continue
-            if obj.get("kind") == "registry_snapshot":
-                snapshots.append(obj)
-            else:
-                records.append(obj)
+    main = Path(path)
+    rotated = Path(str(path) + ".1")
+    paths = [p for p in (rotated, main) if p.exists()]
+    if not paths:
+        raise FileNotFoundError(f"no event log at {path}")
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                if obj.get("kind") == "registry_snapshot":
+                    snapshots.append(obj)
+                else:
+                    records.append(obj)
     return records, snapshots
 
 
@@ -110,6 +139,20 @@ def histogram_quantile(row: dict, q: float) -> float | None:
     return quantile_from_counts(q, bounds, counts)
 
 
+def quantile_is_lower_bound(row: dict, q: float) -> bool:
+    """True when the q-rank of an exported histogram row falls in the +Inf
+    overflow bucket — :func:`histogram_quantile` then clamps to the last
+    finite bucket edge, so the estimate is a LOWER BOUND, not a value
+    (e.g. every observation above the largest bucket).  The report
+    annotates such estimates with ``>=``."""
+    buckets = row.get("buckets", {})
+    total = sum(buckets.values())
+    if total == 0:
+        return False
+    inf_count = buckets.get("+Inf", 0)
+    return q * total > total - inf_count
+
+
 # -------------------------------------------------------------- the report
 def build_report(
     records: list[dict],
@@ -169,10 +212,17 @@ def build_report(
         p50 = snapshot_value(last, "serve.p50_ms")
         p99 = snapshot_value(last, "serve.p99_ms")
         hist = snapshot_histogram(last, "serve.latency_ms")
+        serve: dict[str, Any] = {}
         if p50 is None and hist is not None:
             p50 = histogram_quantile(hist, 0.50)
             p99 = histogram_quantile(hist, 0.99)
-        serve: dict[str, Any] = {}
+            # all-mass-in-overflow (or a tail past the largest bucket):
+            # the estimator clamps to the last finite edge — an honest
+            # LOWER BOUND the report must say so about, not a value
+            if p50 is not None and quantile_is_lower_bound(hist, 0.50):
+                serve["p50_is_lower_bound"] = True
+            if p99 is not None and quantile_is_lower_bound(hist, 0.99):
+                serve["p99_is_lower_bound"] = True
         if p50 is not None:
             serve["p50_ms"] = round(p50, 3)
         if p99 is not None:
@@ -205,6 +255,26 @@ def build_report(
         }
         if pf:
             report["prefetch"] = pf
+
+        # ---- training health (numeric sentry + device watchdogs)
+        health = {
+            key: v
+            for key, name, total in (
+                ("nonfinite_steps", "health.nonfinite_steps_total", False),
+                ("outlier_client_rounds", "health.outlier_clients_total", False),
+                ("param_norm", "health.param_norm", False),
+                ("clip_rate_last", "privacy.clip_rate_last", False),
+                ("xla_compiles", "xla.compiles_total", True),       # per-fn: sum
+                ("xla_recompiles", "xla.recompiles_total", True),
+                ("recompile_storms", "xla.recompile_storms_total", False),
+            )
+            if (
+                v := (snapshot_total(last, name) if total
+                      else snapshot_value(last, name))
+            ) is not None
+        }
+        if health:
+            report["health"] = health
 
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
@@ -258,8 +328,11 @@ def render_text(report: dict) -> str:
     if sv:
         lines.append("## Serving")
         if "p50_ms" in sv or "p99_ms" in sv:
+            p50_pfx = ">=" if sv.get("p50_is_lower_bound") else ""
+            p99_pfx = ">=" if sv.get("p99_is_lower_bound") else ""
             lines.append(
-                f"latency: p50={sv.get('p50_ms')}ms p99={sv.get('p99_ms')}ms"
+                f"latency: p50={p50_pfx}{sv.get('p50_ms')}ms "
+                f"p99={p99_pfx}{sv.get('p99_ms')}ms"
             )
         counters = ", ".join(
             f"{k}={int(sv[k])}"
@@ -280,6 +353,26 @@ def render_text(report: dict) -> str:
             f"{int(pf.get('producer_stalls', 0))}, consumer stalls: "
             f"{int(pf.get('consumer_stalls', 0))}"
         )
+        lines.append("")
+    hl = report.get("health")
+    if hl:
+        lines.append("## Health")
+        if "nonfinite_steps" in hl:
+            lines.append(f"non-finite step cells: {int(hl['nonfinite_steps'])}")
+        if "outlier_client_rounds" in hl:
+            lines.append(
+                f"outlier client-rounds: {int(hl['outlier_client_rounds'])}"
+            )
+        if "param_norm" in hl:
+            lines.append(f"param norm (last): {hl['param_norm']:.4g}")
+        if "clip_rate_last" in hl:
+            lines.append(f"dp clip rate (last step): {hl['clip_rate_last']:.4f}")
+        if "xla_compiles" in hl:
+            lines.append(
+                f"xla compiles: {int(hl['xla_compiles'])} "
+                f"(recompiles: {int(hl.get('xla_recompiles', 0))}, "
+                f"storms: {int(hl.get('recompile_storms', 0))})"
+            )
         lines.append("")
     if "cap_overflow_steps" in report:
         lines.append(f"cap-overflow steps: {int(report['cap_overflow_steps'])}")

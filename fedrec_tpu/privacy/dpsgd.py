@@ -30,21 +30,35 @@ def _global_norm(tree: Any) -> jnp.ndarray:
     return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
 
 
+def per_example_global_norms(per_example_grads: Any) -> jnp.ndarray:
+    """(B,) global gradient norm per example — the quantity DP-SGD clips
+    against, and the one the health sentry's clip-rate is defined over."""
+    return jnp.sqrt(
+        sum(
+            jnp.sum(jnp.square(x), axis=tuple(range(1, x.ndim)))
+            for x in jax.tree_util.tree_leaves(per_example_grads)
+        )
+    )
+
+
+def _apply_clip(per_example_grads: Any, norms: jnp.ndarray, clip_norm: float) -> Any:
+    """THE clip body: scale each example's pytree so its global norm is
+    <= clip_norm, given precomputed per-example norms — shared by the
+    standalone helper and the DP-SGD estimator so the clipping epsilon
+    and broadcast can never diverge between them."""
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norms, 1e-12))  # (B,)
+    return jax.tree_util.tree_map(
+        lambda x: x * scale.reshape((-1,) + (1,) * (x.ndim - 1)), per_example_grads
+    )
+
+
 def clip_by_global_norm_per_example(per_example_grads: Any, clip_norm: float) -> Any:
     """Scale each example's gradient pytree to global norm <= clip_norm.
 
     ``per_example_grads`` leaves have a leading batch axis.
     """
-    norms = jnp.sqrt(
-        sum(
-            jnp.sum(jnp.square(x), axis=tuple(range(1, x.ndim)))
-            for x in jax.tree_util.tree_leaves(per_example_grads)
-        )
-    )  # (B,)
-    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norms, 1e-12))  # (B,)
-    return jax.tree_util.tree_map(
-        lambda x: x * scale.reshape((-1,) + (1,) * (x.ndim - 1)), per_example_grads
-    )
+    norms = per_example_global_norms(per_example_grads)  # (B,)
+    return _apply_clip(per_example_grads, norms, clip_norm)
 
 
 def add_gaussian_noise(tree: Any, rng: jax.Array, std: float | jnp.ndarray) -> Any:
@@ -62,22 +76,37 @@ def per_example_clipped_grads(
     params: Any,
     batch_args: tuple,
     clip_norm: float,
-) -> tuple[jnp.ndarray, Any]:
+    with_stats: bool = False,
+) -> tuple:
     """Mean of per-example clipped gradients (the DP-SGD estimator).
 
     ``per_example_loss_fn(params, *example_args) -> scalar`` is vmapped over
     the leading axis of every element of ``batch_args``. Returns
     ``(mean_loss, mean_clipped_grads)``; noise is the caller's job (it needs
     the PRNG and the B divisor).
+
+    ``with_stats=True`` appends a clipping-stats dict — ``clip_rate``
+    (fraction of the batch whose pre-clip global norm strictly exceeded
+    C, i.e. whose gradient was actually scaled) and ``max_norm`` of the
+    pre-clip norms — the health sentry's DP observability surface (an
+    all-clipped batch means C is strangling the signal; a never-clipped
+    one means C buys no sensitivity bound).
     """
     grad_fn = jax.vmap(
         jax.value_and_grad(per_example_loss_fn),
         in_axes=(None,) + (0,) * len(batch_args),
     )
     losses, grads = grad_fn(params, *batch_args)
-    clipped = clip_by_global_norm_per_example(grads, clip_norm)
+    norms = per_example_global_norms(grads)  # (B,)
+    clipped = _apply_clip(grads, norms, clip_norm)
     mean_grads = jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), clipped)
-    return jnp.mean(losses), mean_grads
+    if not with_stats:
+        return jnp.mean(losses), mean_grads
+    stats = {
+        "clip_rate": jnp.mean((norms > clip_norm).astype(jnp.float32)),
+        "max_norm": jnp.max(norms),
+    }
+    return jnp.mean(losses), mean_grads, stats
 
 
 def make_noise_fn(privacy: PrivacyConfig, batch_size: int) -> Callable | None:

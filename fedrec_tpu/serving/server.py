@@ -387,6 +387,7 @@ async def serve_forever(
     metrics_every_s: float = 30.0,
     logger=None,
     obs_dir: str | None = None,
+    jsonl_max_mb: float = 0.0,
 ) -> None:
     """CLI entry loop: listen until SIGINT/SIGTERM, logging metrics
     periodically.  Shutdown is graceful BY CONSTRUCTION: the signal only
@@ -418,7 +419,12 @@ async def serve_forever(
                 service.log_metrics(logger, step)
             if obs_dir is not None:
                 # periodic registry snapshots make the event log useful
-                # even when the server is killed rather than signalled
+                # even when the server is killed rather than signalled;
+                # size-rotate first so a long-lived server cannot fill
+                # the disk (obs.jsonl_max_mb)
+                from fedrec_tpu.obs import rotate_jsonl
+
+                rotate_jsonl(Path(obs_dir) / "metrics.jsonl", jsonl_max_mb)
                 service.registry.write_snapshot(Path(obs_dir) / "metrics.jsonl")
 
     heartbeat = asyncio.ensure_future(beat())

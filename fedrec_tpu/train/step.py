@@ -74,6 +74,23 @@ def _scale_grad_bwd(s, g):
 _scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)
 
 
+def _tree_global_norm(*trees: Any) -> jnp.ndarray:
+    """Global L2 norm over every leaf of every (non-None) tree, accumulated
+    in float32 — the health sentry's one norm definition (grad, update and
+    param norms all use it, so their scales are comparable)."""
+    leaves = [
+        leaf
+        for t in trees
+        if t is not None
+        for leaf in jax.tree_util.tree_leaves(t)
+    ]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
 def _unstack(tree: Any) -> Any:
     """Strip the local leading block dim (size 1) inside shard_map."""
     return jax.tree_util.tree_map(lambda x: x[0], tree)
@@ -545,10 +562,20 @@ def _build_local_step(
             "noises only the news grads, which contradicts a user-only scope"
         )
 
+    # in-graph numeric sentry (obs.health.sentry): the step additionally
+    # returns per-client grad/update/param global norms and a non-finite
+    # flag (+ DP clip-rate under dpsgd) — computed on device, fetched by
+    # the host with the round's losses, so a silent NaN or a divergent
+    # client is visible without a blocking readback per step
+    sentry = cfg.obs.health.sentry
+
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         # trace-time cap resolution: each compiled per-client batch shape
         # gets the bound its own B implies (bucketed policy or the global)
         cap = resolve_unique_cap(cfg, batch["labels"].shape[0])
+        dp_stats = None
+        sentry_grads: tuple = ()
+        sentry_updates: tuple = ()
         rng, dropout_rng, noise_rng = jax.random.split(state.rng, 3)
         # text-encoder dropout key must be IDENTICAL across seq shards so the
         # replicated candidate encode stays replicated (finetune mode)
@@ -591,22 +618,27 @@ def _build_local_step(
                     batch["candidates"], batch["history"], batch["labels"], ex_rngs,
                 )
                 if dp_user_only:
-                    loss, user_g = per_example_clipped_grads(
+                    out = per_example_clipped_grads(
                         lambda up, c, h, l, r: per_example_loss(
                             (up, state.news_params), c, h, l, r
                         ),
                         state.user_params,
                         batch_args,
                         cfg.privacy.clip_norm,
+                        with_stats=sentry,
                     )
+                    loss, user_g = out[0], out[1]
                     news_g = None  # head frozen: no grad exists to leak
                 else:
-                    loss, (user_g, news_g) = per_example_clipped_grads(
+                    out = per_example_clipped_grads(
                         per_example_loss,
                         (state.user_params, state.news_params),
                         batch_args,
                         cfg.privacy.clip_norm,
+                        with_stats=sentry,
                     )
+                    loss, (user_g, news_g) = out[0], out[1]
+                dp_stats = out[2] if sentry else None
             else:
 
                 def loss_fn(user_params, news_params):
@@ -672,8 +704,14 @@ def _build_local_step(
                     (user_g,) = noise_fn((user_g,), noise_rng)
                 else:
                     user_g, news_g = noise_fn((user_g, news_g), noise_rng)
+            # sentry sees the PER-CLIENT grads (post-noise, pre-sync): the
+            # synced mean is what steps the optimizer, but a diverging or
+            # poisoned client is only visible before the collective blends
+            # its gradient into the cohort's
+            sentry_grads = (user_g, news_g)
             user_g = strategy.sync_grads(user_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
+            n_updates = None
             if news_g is None:
                 new_news_params, opt_news = state.news_params, state.opt_news
             else:
@@ -684,6 +722,7 @@ def _build_local_step(
                 new_news_params = jax.tree_util.tree_map(
                     lambda p, u: p + u, state.news_params, n_updates
                 )
+            sentry_updates = (u_updates, n_updates)
             new_state = state.replace(
                 step=state.step + 1,
                 user_params=jax.tree_util.tree_map(
@@ -717,6 +756,7 @@ def _build_local_step(
 
             if noise_fn is not None:
                 user_g, cand_g, his_g = noise_fn((user_g, cand_g, his_g), noise_rng)
+            sentry_grads = (user_g, cand_g, his_g)
 
             # per-nid scatter-add (reference process_news_grad, main.py:20-42)
             d = cand_g.shape[-1]
@@ -730,6 +770,7 @@ def _build_local_step(
 
             user_g = strategy.sync_grads(user_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
+            sentry_updates = (u_updates,)
             new_state = state.replace(
                 step=state.step + 1,
                 user_params=jax.tree_util.tree_map(
@@ -744,6 +785,27 @@ def _build_local_step(
 
         mean_loss = lax.pmean(loss, axis_name=sync_axes)
         metrics = {"loss": loss, "mean_loss": mean_loss}
+        if sentry:
+            grad_norm = _tree_global_norm(*sentry_grads)
+            update_norm = _tree_global_norm(*sentry_updates)
+            param_norm = _tree_global_norm(
+                new_state.user_params, new_state.news_params
+            )
+            finite = (
+                jnp.isfinite(loss)
+                & jnp.isfinite(grad_norm)
+                & jnp.isfinite(update_norm)
+                & jnp.isfinite(param_norm)
+            )
+            metrics["health.grad_norm"] = grad_norm
+            metrics["health.update_norm"] = update_norm
+            metrics["health.param_norm"] = param_norm
+            # int32 sentinel, not bool: scan stacks it over steps and the
+            # host sums it — "how many step×client cells went non-finite"
+            metrics["health.nonfinite"] = 1 - finite.astype(jnp.int32)
+            if dp_stats is not None:
+                metrics["health.clip_rate"] = dp_stats["clip_rate"]
+                metrics["health.clip_max_norm"] = dp_stats["max_norm"]
         capped = (
             cap
             and not use_dpsgd

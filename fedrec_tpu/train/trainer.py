@@ -58,7 +58,17 @@ from fedrec_tpu.train.step import (
     stack_batches,
     stack_rounds,
 )
-from fedrec_tpu.obs import dump_artifacts, get_registry, get_tracer
+from fedrec_tpu.obs import (
+    CompileWatchdog,
+    FlightRecorder,
+    HealthMonitor,
+    TrainingHealthError,
+    dump_artifacts,
+    get_registry,
+    get_tracer,
+    rotate_jsonl,
+    sample_device_memory,
+)
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
 
@@ -396,7 +406,43 @@ class Trainer:
             run_name=cfg.train.run_name,
             jsonl_path=jsonl_path,
             registry=self.registry,
+            jsonl_max_mb=cfg.obs.jsonl_max_mb,
         )
+
+        # ---- training-health flight recorder (fedrec_tpu.obs.health) +
+        # device watchdogs (fedrec_tpu.obs.device). The monitor digests the
+        # in-graph sentry's per-client health vectors at round cadence; the
+        # recorder keeps the last-N batches + the round-entry state so a
+        # non-finite trigger dumps a replayable forensic bundle.
+        hcfg = cfg.obs.health
+        self.health = HealthMonitor(hcfg, registry=self.registry)
+        self.flightrec: FlightRecorder | None = None
+        if self._obs_dir is not None and hcfg.flight_recorder:
+            self.flightrec = FlightRecorder(
+                ring_size=hcfg.ring_size,
+                dump_policy=hcfg.dump_policy,
+                dump_table_max_mb=hcfg.dump_table_max_mb,
+            )
+        self.watchdog = CompileWatchdog(
+            registry=self.registry,
+            storm_threshold=hcfg.storm_threshold,
+            storm_window_s=hcfg.storm_window_s,
+        )
+        self.watchdog.install()
+        # every jitted program goes through the watchdog so each XLA
+        # compile carries (callable, arg shapes) provenance — the steady-
+        # shape paths must show exactly one compile per signature
+        self.train_step = self.watchdog.watch(self.train_step, "train_step")
+        if self.train_scan is not None:
+            self.train_scan = self.watchdog.watch(self.train_scan, "train_scan")
+        if self.round_scan is not None:
+            self.round_scan = self.watchdog.watch(self.round_scan, "round_scan")
+        self.eval_step = self.watchdog.watch(self.eval_step, "eval_step")
+        self.full_eval_step = self.watchdog.watch(
+            self.full_eval_step, "full_eval_step"
+        )
+        self.param_sync = self.watchdog.watch(self.param_sync, "param_sync")
+
         self._table: jnp.ndarray | None = None  # decoupled-mode news-vec table
         self._adopt_fn = None  # lazy compiled set_global_params program
         self.last_per_client_metrics: list[dict[str, float]] | None = None
@@ -649,6 +695,133 @@ class Trainer:
             },
         )
 
+    # ------------------------------------------------- health / forensics
+    def _host_state(self) -> Any:
+        """Host (numpy) copy of the full stacked client state — the flight
+        recorder's chunk-entry checkpoint. Device buffers may be donated
+        away by the time a trigger fires, so the copy is eager."""
+        return jax.tree_util.tree_map(np.asarray, self.state)
+
+    def _entry_state(self) -> Any:
+        """The round/chunk-entry state the flight recorder keeps — None
+        when obs.health.snapshot_state is off (the per-round D2H copy is
+        the recorder's dominant cost at large model x cohort scale; dumps
+        then carry the batch ring but cannot replay)."""
+        return self._host_state() if self.cfg.obs.health.snapshot_state else None
+
+    def _dump_meta(self) -> dict:
+        return {
+            "num_news": self.data.num_news,
+            "title_len": self.data.title_len,
+            "mode": self.mode,
+            "num_local_samples": self.num_local_samples,
+        }
+
+    def _check_health(
+        self,
+        start_round: int,
+        health_rows: list[dict] | None = None,
+        metrics3d: dict | None = None,
+        round_losses: tuple | list = (),
+    ) -> None:
+        """Digest one round's (or chunk's) fetched sentry arrays through the
+        HealthMonitor; on a trigger, dump the flight recorder and (for a
+        non-finite sentinel under abort_on_nonfinite) raise
+        TrainingHealthError. One sync point per round — the arrays were
+        produced asynchronously alongside the loss readback."""
+        if not self.cfg.obs.health.sentry:
+            return
+        if metrics3d is not None:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in metrics3d.items()
+                if k.startswith("health.")
+            }
+        elif health_rows:
+            c = self.cfg.fed.num_clients
+            keys = health_rows[0].keys()
+            arrays = {
+                k: np.concatenate(
+                    [np.asarray(r[k]).reshape(-1, c) for r in health_rows]
+                )[None]
+                for k in keys
+            }
+        else:
+            return
+        if not arrays:
+            return
+        trigger = self.health.check(start_round, arrays, list(round_losses))
+        if trigger is None:
+            return
+        dump_dir = self._dump_flightrec(trigger)
+        kind = trigger["kind"]
+        where = f"round {trigger.get('round')}"
+        if trigger.get("step") is not None:
+            where += f" step {trigger['step']} client {trigger.get('client')}"
+        detail = trigger.get("detail") or {
+            k: trigger[k] for k in ("round_loss", "trailing_mean")
+            if k in trigger
+        }
+        if dump_dir:
+            hint = (
+                f" Forensics dumped to {dump_dir} — confirm with "
+                f"`fedrec-obs replay {dump_dir}`."
+            )
+        elif self.flightrec is not None:
+            hint = (
+                " Flight-recorder dump suppressed by "
+                f"obs.health.dump_policy={self.cfg.obs.health.dump_policy!r}"
+                f" (earlier dump: {self.flightrec.last_dump_dir})."
+            )
+        else:
+            hint = (
+                " Set obs.dir (+ obs.health.flight_recorder) for a "
+                "replayable dump."
+            )
+        msg = (
+            f"training-health trigger [{kind}] at {where}: {detail}.{hint}"
+        )
+        if kind == "nonfinite" and self.cfg.obs.health.abort_on_nonfinite:
+            raise TrainingHealthError(msg)
+        print(f"[trainer] WARNING: {msg}")
+
+    def _dump_flightrec(self, trigger: dict):
+        if self.flightrec is None:
+            return None
+        try:
+            table = np.asarray(self._feature_table())
+        except Exception:  # noqa: BLE001 — forensics must not mask the trigger
+            table = None
+        try:
+            return self.flightrec.dump(
+                self._obs_dir / "flightrec",
+                trigger,
+                cfg=self.cfg,
+                registry=self.registry,
+                table=table,
+                meta=self._dump_meta(),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"[trainer] flight-recorder dump failed: "
+                  f"{type(e).__name__}: {e}")
+            return None
+
+    def _flightrec_on_exception(self, e: BaseException) -> None:
+        """Last-chance forensics: a run dying to an exception that never
+        reached a round-end health check (dispatch error, cap-overflow
+        abort) still dumps its batch ring + chunk-entry state."""
+        if self.flightrec is None or self.flightrec.dump_count > 0:
+            return
+        if not isinstance(e, Exception):
+            return  # KeyboardInterrupt/SystemExit: exit fast, no dump
+        self._dump_flightrec({
+            "kind": "exception",
+            "error": type(e).__name__,
+            "message": str(e)[:500],
+            "round": None,
+            "step": None,
+        })
+
     def _mask_rng(self, round_idx: int) -> jax.Array:
         """THE per-round participation-mask key — host-driven rounds and
         rounds-in-jit chunks both derive masks from this one expression, so
@@ -669,6 +842,11 @@ class Trainer:
         with self.tracer.span("fed_round", step_num=round_idx, num_rounds=1), \
                 jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx):
             result = self._train_round_inner(round_idx)
+            # HBM gauges at the round boundary, attributed (as an instant
+            # event) to this fed_round span; no-op on allocator-less CPU
+            sample_device_memory(
+                self.registry, self.tracer, fed_round=round_idx
+            )
         self._m_round_secs.observe(_time.perf_counter() - t0)
         return result
 
@@ -679,6 +857,11 @@ class Trainer:
         weights = participation_mask(
             self._mask_rng(round_idx), cfg.fed.num_clients, cfg.fed.participation
         )
+        if self.flightrec is not None:
+            self.flightrec.start_chunk(
+                round_idx, self._entry_state(),
+                {round_idx: np.asarray(weights)},
+            )
 
         round_start_global = None
         if self.server_opt is not None:
@@ -695,9 +878,20 @@ class Trainer:
 
         losses = []
         overflows = []  # device arrays; read once at round end (no per-step sync)
+        # sentry aux vectors, same deal: appended as device arrays, one
+        # host fetch at the round-end health check
+        health_rows: list[dict] = []
         scan_s = cfg.train.scan_steps if self.train_scan is not None else 1
 
         tracer = self.tracer
+
+        def keep_metrics(metrics) -> None:
+            losses.append(metrics["mean_loss"])
+            if "unique_overflow" in metrics:
+                overflows.append(metrics["unique_overflow"])
+            row = {k: v for k, v in metrics.items() if k.startswith("health.")}
+            if row:
+                health_rows.append(row)
 
         def dispatch(group: list, table) -> None:
             self._m_steps.inc(len(group))
@@ -718,14 +912,11 @@ class Trainer:
                         self.state, metrics = self.train_step(
                             self.state, sharded, table
                         )
-                    losses.append(metrics["mean_loss"])
-                    if "unique_overflow" in metrics:
-                        overflows.append(metrics["unique_overflow"])
+                    keep_metrics(metrics)
                 return
-            losses.append(metrics["mean_loss"])  # (scan_s, clients)
-            if "unique_overflow" in metrics:
-                overflows.append(metrics["unique_overflow"])
+            keep_metrics(metrics)  # scan chain: (scan_s, clients) entries
 
+        step_in_round = 0
         for local_epoch in range(cfg.fed.local_epochs):
             epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
             table = self._feature_table()
@@ -746,6 +937,11 @@ class Trainer:
                         "batch_build", dur_s=tracer.now() - t_build,
                         epoch=epoch_idx,
                     )
+                    if self.flightrec is not None:
+                        self.flightrec.record(
+                            batch, round_idx, epoch_idx, step_in_round
+                        )
+                    step_in_round += 1
                     group.append(batch)
                     if len(group) == scan_s:
                         dispatch(group, table)
@@ -791,6 +987,18 @@ class Trainer:
             elif self.mode == "decoupled":
                 self._refresh_table()
 
+        # flat mean over every (step, client) cell: scan chains contribute one
+        # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
+        # so a mean-of-entry-means would overweight the epoch tail
+        train_loss = float(
+            np.mean(np.concatenate([np.asarray(l).reshape(-1) for l in losses]))
+        )
+        # sentry digest FIRST: a non-finite sentinel is the root cause the
+        # operator needs (and dumps the flight recorder) before any other
+        # abort gets to describe the same broken round differently
+        self._check_health(
+            round_idx, health_rows=health_rows, round_losses=[train_loss]
+        )
         if overflows:
             # per entry: max over clients (replicated psum total per step),
             # then sum over the entry's steps — a scan chain contributes a
@@ -801,12 +1009,6 @@ class Trainer:
             if total > 0:
                 self._m_overflow.inc(total)
                 raise RuntimeError(self._overflow_message(total))
-        # flat mean over every (step, client) cell: scan chains contribute one
-        # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
-        # so a mean-of-entry-means would overweight the epoch tail
-        train_loss = float(
-            np.mean(np.concatenate([np.asarray(l).reshape(-1) for l in losses]))
-        )
         result = RoundResult(round_idx, train_loss)
         self._eval_if_due(result)
         return result
@@ -898,6 +1100,9 @@ class Trainer:
         )
         with chunk_span, chunk_annotation:
             results = self._train_rounds_scan_inner(round_idx, num_rounds)
+            sample_device_memory(
+                self.registry, self.tracer, fed_round=round_idx
+            )
         # the chunk is one dispatch; attribute its wall time evenly so the
         # per-round histogram stays comparable across dispatch modes
         per_round = (_time.perf_counter() - t0) / num_rounds
@@ -923,6 +1128,11 @@ class Trainer:
             for r in range(round_idx, round_idx + num_rounds)
         ])
         table = self._feature_table()
+        if self.flightrec is not None:
+            self.flightrec.start_chunk(
+                round_idx, self._entry_state(),
+                {round_idx + i: weights[i] for i in range(num_rounds)},
+            )
 
         with tracer.span(
             "batch_build", kind="round_stack", rounds=num_rounds
@@ -933,16 +1143,19 @@ class Trainer:
                 batches: list[dict] = []
                 for local_epoch in range(cfg.fed.local_epochs):
                     epoch_idx = r * cfg.fed.local_epochs + local_epoch
-                    batches.extend(
-                        {
+                    for b in self.batcher.epoch_batches_sharded(
+                        cfg.fed.num_clients, epoch_idx
+                    ):
+                        batch = {
                             "candidates": b.candidates,
                             "history": b.history,
                             "labels": b.labels,
                         }
-                        for b in self.batcher.epoch_batches_sharded(
-                            cfg.fed.num_clients, epoch_idx
-                        )
-                    )
+                        if self.flightrec is not None:
+                            self.flightrec.record(
+                                batch, r, epoch_idx, len(batches)
+                            )
+                        batches.append(batch)
                 if steps is None:
                     steps = len(batches)
                 elif len(batches) != steps:
@@ -970,6 +1183,20 @@ class Trainer:
                 self.state, stacked, table, jnp.asarray(weights)
             )
 
+        mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
+        results = []
+        for i in range(num_rounds):
+            # flat mean over every (step, client) cell — same reduction as
+            # the host-driven round's loss bookkeeping
+            results.append(
+                RoundResult(round_idx + i, float(mean_loss[i].mean()))
+            )
+        # sentry digest first (see _train_round_inner): the health arrays
+        # are already (rounds, steps, clients) in the chunk's metrics
+        self._check_health(
+            round_idx, metrics3d=metrics,
+            round_losses=[r.train_loss for r in results],
+        )
         if "unique_overflow" in metrics:
             # (rounds, steps, clients): max over clients (replicated psum
             # total), then count every overflowed step in the chunk
@@ -979,15 +1206,6 @@ class Trainer:
             if total > 0:
                 self._m_overflow.inc(total)
                 raise RuntimeError(self._overflow_message(total))
-
-        mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
-        results = []
-        for i in range(num_rounds):
-            # flat mean over every (step, client) cell — same reduction as
-            # the host-driven round's loss bookkeeping
-            results.append(
-                RoundResult(round_idx + i, float(mean_loss[i].mean()))
-            )
         # only the chunk's last round can sit on an eval boundary
         # (_round_chunk guarantees it); earlier rounds get no metrics, same
         # as host-driven rounds off the eval cadence
@@ -1144,6 +1362,12 @@ class Trainer:
                     round_idx += len(results)
             if self.snapshots is not None:
                 self.snapshots.wait()  # settle async saves before handing back
+        except BaseException as e:
+            # forensics on EVERY failing exit path: an exception that never
+            # reached a round-end health check (dispatch error, cap
+            # overflow) still dumps the batch ring + chunk-entry state
+            self._flightrec_on_exception(e)
+            raise
         finally:
             # artifacts on EVERY exit path: a run that died to a cap
             # overflow (or any mid-round error) is exactly the run whose
@@ -1265,4 +1489,7 @@ class Trainer:
             self._obs_dir is not None
             and (round_idx + 1) % max(cfg.obs.snapshot_every, 1) == 0
         ):
+            # size-based rotation before the append (obs.jsonl_max_mb):
+            # snapshots are the event log's bulk on long runs
+            rotate_jsonl(self._obs_dir / "metrics.jsonl", cfg.obs.jsonl_max_mb)
             self.registry.write_snapshot(self._obs_dir / "metrics.jsonl")

@@ -38,6 +38,7 @@ class MetricLogger:
         stream=None,
         jsonl_path: str | None = None,
         registry=None,
+        jsonl_max_mb: float = 0.0,
     ):
         self.stream = stream or sys.stdout
         self._t0 = time.time()
@@ -45,7 +46,14 @@ class MetricLogger:
         self._records = self._registry.counter(
             "log.records_total", "metric-log records emitted"
         )
-        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        # append-per-write (no held handle): the event log is shared with
+        # registry.write_snapshot appends AND may be size-rotated out from
+        # under us (obs.jsonl_max_mb) — a persistent handle would follow
+        # the renamed inode and write new records into the OLD file
+        self._jsonl_path = jsonl_path
+        self._jsonl_max_mb = float(jsonl_max_mb or 0.0)
+        if jsonl_path:
+            open(jsonl_path, "a").close()  # fail fast on an unwritable path
         self._wandb = None
         if use_wandb:
             try:
@@ -79,9 +87,13 @@ class MetricLogger:
         record = {"step": step, "elapsed_sec": round(time.time() - self._t0, 2), **clean}
         line = json.dumps(record)
         print(line, file=self.stream, flush=True)
-        if self._jsonl is not None:
-            self._jsonl.write(line + "\n")
-            self._jsonl.flush()
+        if self._jsonl_path is not None:
+            if self._jsonl_max_mb > 0:
+                from fedrec_tpu.obs.report import rotate_jsonl
+
+                rotate_jsonl(self._jsonl_path, self._jsonl_max_mb)
+            with open(self._jsonl_path, "a") as f:
+                f.write(line + "\n")
         # registry backend: the logged schema doubles as gauges, so snapshots
         # and the Prometheus exposition carry training_loss/valid_auc/... too
         for k, f in numeric.items():
@@ -94,8 +106,6 @@ class MetricLogger:
             self._wandb.log(numeric, step=step)
 
     def finish(self) -> None:
-        if self._jsonl is not None:
-            self._jsonl.close()
-            self._jsonl = None
+        self._jsonl_path = None  # writes after finish() go nowhere, as before
         if self._wandb is not None:
             self._wandb.finish()

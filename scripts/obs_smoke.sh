@@ -1,5 +1,5 @@
 #!/bin/bash
-# Observability smoke (the ISSUE-3 acceptance scenario), CPU-only:
+# Observability smoke (ISSUE-3 + ISSUE-4 acceptance scenarios), CPU-only:
 #
 #   1. a 2-round synthetic training run with obs.dir set (+ DP so the
 #      epsilon gauge is live, + prefetch so queue health is live),
@@ -8,7 +8,12 @@
 #      a valid Perfetto/Chrome trace with >= 4 distinct span names, a
 #      Prometheus exposition carrying serve p50/p99 + prefetch queue
 #      depth + privacy.epsilon_spent — and that fedrec-obs renders both
-#      into run reports.
+#      into run reports,
+#   4. a forced-NaN micro-run (inf lr for step 1): the numeric sentry
+#      must abort the run, the flight recorder must dump the offending
+#      batch + state + manifest + registry snapshot under
+#      obs.dir/flightrec/, and `fedrec-obs replay` must reproduce the
+#      non-finite step on CPU (exit 0 = REPRODUCED).
 #
 #   scripts/obs_smoke.sh     # or: make obs-smoke
 #
@@ -25,7 +30,7 @@ run() {
         XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
 }
 
-echo "== [1/3] 2-round CPU training run (DP + prefetch) =="
+echo "== [1/4] 2-round CPU training run (DP + prefetch) =="
 run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --synthetic --synthetic-train 512 --synthetic-news 128 \
     --mode joint --dp-epsilon 10 \
@@ -38,14 +43,14 @@ run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --set train.eval_protocol=sampled > "$OUT/train.log" 2>&1 \
     || { tail -30 "$OUT/train.log"; exit 1; }
 
-echo "== [2/3] serve_load run =="
+echo "== [2/4] serve_load run =="
 run python benchmarks/serve_load.py --num-news 2000 --his-len 10 \
     --clients 4 --rate 50 --duration 2 --out obs_smoke_serve_load.json \
     --obs-dir "$OUT/serve" > "$OUT/serve.log" 2>&1 \
     || { tail -30 "$OUT/serve.log"; exit 1; }
 rm -f benchmarks/obs_smoke_serve_load.json
 
-echo "== [3/3] artifact assertions =="
+echo "== [3/4] artifact assertions =="
 for d in train serve; do
     for f in metrics.jsonl trace.json prometheus.txt; do
         [ -s "$OUT/$d/$f" ] || { echo "MISSING $OUT/$d/$f"; exit 1; }
@@ -84,4 +89,31 @@ EOF
 echo "== run reports =="
 python -m fedrec_tpu.cli.obs report "$OUT/train"
 python -m fedrec_tpu.cli.obs report "$OUT/serve"
+
+echo "== [4/4] forced-NaN flight-recorder round-trip =="
+# inf lr: the first optimizer update goes non-finite, the sentry trips,
+# the run must ABORT (nonzero exit) after dumping forensics
+if run python -m fedrec_tpu.cli.run 2 16 1000 --strategy param_avg --clients 8 \
+    --synthetic --synthetic-train 256 --synthetic-news 64 --mode joint \
+    --obs-dir "$OUT/nan" \
+    --set optim.user_lr=inf \
+    --set model.news_dim=32 --set model.num_heads=4 --set model.head_dim=8 \
+    --set model.query_dim=16 --set model.bert_hidden=48 \
+    --set data.max_his_len=10 --set data.max_title_len=12 \
+    --set train.snapshot_dir="$OUT/nan_snap" --set train.eval_every=1000 \
+    > "$OUT/nan.log" 2>&1; then
+    echo "forced-NaN run exited 0 — the sentry did not abort"; exit 1
+fi
+grep -q "training-health trigger \[nonfinite\]" "$OUT/nan.log" \
+    || { echo "no nonfinite trigger in nan.log"; tail -20 "$OUT/nan.log"; exit 1; }
+for f in manifest.json state.msgpack registry.json table.npy batch_000.npz; do
+    [ -s "$OUT/nan/flightrec/$f" ] || { echo "MISSING flightrec/$f"; exit 1; }
+done
+# the dump must replay deterministically on CPU and reproduce the flag
+run python -m fedrec_tpu.cli.obs replay "$OUT/nan" > "$OUT/replay.log" 2>&1 \
+    || { echo "replay did not reproduce the non-finite step"; \
+         tail -20 "$OUT/replay.log"; exit 1; }
+grep -q "REPRODUCED" "$OUT/replay.log" \
+    || { echo "replay verdict missing"; tail -5 "$OUT/replay.log"; exit 1; }
+echo "  forced-NaN: abort + complete flightrec dump + replay REPRODUCED"
 echo "OBS_SMOKE=PASS"

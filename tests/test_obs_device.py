@@ -1,0 +1,95 @@
+"""CompileWatchdog + HBM sampling: a deliberate shape-churn loop reports
+exactly the expected compile count with shape provenance; a steady-shape
+loop reports one warmup compile and ZERO recompiles; memory sampling is a
+clean no-op on allocator-less CPU and publishes gauges from real stats."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedrec_tpu.obs import (
+    CompileWatchdog,
+    MetricsRegistry,
+    Tracer,
+    sample_device_memory,
+    set_active_watchdog,
+)
+
+
+@pytest.fixture()
+def watchdog():
+    reg = MetricsRegistry()
+    wd = CompileWatchdog(registry=reg, storm_threshold=3, storm_window_s=60.0)
+    prev = wd.install()
+    try:
+        yield wd, reg
+    finally:
+        set_active_watchdog(prev)
+
+
+def test_shape_churn_reports_exact_compile_count_with_provenance(watchdog):
+    wd, reg = watchdog
+    f = wd.watch(jax.jit(lambda x: (x * 2 + 1).sum()), "churn")
+    for n in (3, 4, 5, 6):  # four DISTINCT shapes -> four compilations
+        f(jnp.ones((n,)))
+    assert wd.compiles("churn") == 4
+    assert wd.recompiles("churn") == 0  # every compile was a new signature
+    shapes = [p["shapes"] for p in wd.provenance() if p["fn"] == "churn"]
+    assert len(shapes) == 4
+    assert any("[3]" in s for s in shapes) and any("[6]" in s for s in shapes)
+    # churning the SAME callable >= storm_threshold times inside the
+    # window is a storm, with the count in the registry
+    assert reg.counter("xla.recompile_storms_total").value() >= 1
+    # re-running the same shapes hits the jit cache: no new compiles
+    for n in (3, 4, 5, 6):
+        f(jnp.ones((n,)))
+    assert wd.compiles("churn") == 4
+
+
+def test_steady_shape_zero_recompiles_after_warmup(watchdog):
+    wd, reg = watchdog
+    g = wd.watch(jax.jit(lambda x: jnp.sin(x) @ x), "steady")
+    for _ in range(6):
+        g(jnp.ones((4, 4)))
+    assert wd.compiles("steady") == 1  # the one warmup compile
+    assert wd.recompiles("steady") == 0
+    assert reg.counter("xla.compiles_total", labels=("fn",)).value(fn="steady") == 1
+    # compile seconds were accounted
+    assert reg.counter("xla.compile_seconds_total").value() > 0
+
+
+def test_multiple_signatures_are_warmup_not_recompiles(watchdog):
+    """Bucketed batch shapes each compile ONCE — that is warmup, not cache
+    thrash; recompiles stay zero as long as no signature repeats a compile."""
+    wd, _ = watchdog
+    h = wd.watch(jax.jit(lambda x: x.sum()), "bucketed")
+    for n in (8, 16):
+        for _ in range(3):
+            h(jnp.ones((n,)))
+    assert wd.compiles("bucketed") == 2
+    assert wd.recompiles("bucketed") == 0
+
+
+def test_memory_sampling_cpu_noop_and_fake_device():
+    reg = MetricsRegistry()
+    tr = Tracer()
+    # CPU devices report no allocator stats -> clean no-op
+    assert sample_device_memory(reg, tr) == 0
+
+    class FakeDev:
+        id = 3
+
+        def memory_stats(self):
+            return {"bytes_in_use": 1024, "peak_bytes_in_use": 4096,
+                    "bytes_limit": 2 ** 30}
+
+    n = sample_device_memory(reg, tr, devices=[FakeDev()], fed_round=7)
+    assert n == 1
+    g = reg.gauge("device.memory.bytes_in_use", labels=("device",))
+    assert g.value(device="3") == 1024
+    (ev,) = [e for e in tr.events() if e["name"] == "hbm"]
+    assert ev["args"]["fed_round"] == 7 and ev["args"]["peak_bytes_in_use"] == 4096

@@ -1,0 +1,252 @@
+"""HealthMonitor / FlightRecorder units (numpy-level, no training run):
+trigger detection, outlier flagging, DP clip-rate exactness against a
+hand-computed fraction, ring bounding, dump completeness, jsonl rotation."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.config import HealthConfig
+from fedrec_tpu.obs import MetricsRegistry, rotate_jsonl
+from fedrec_tpu.obs.health import FlightRecorder, HealthMonitor
+from fedrec_tpu.obs.report import load_jsonl
+
+
+def _rows(S=3, C=4, **over):
+    """(1, S, C) finite health arrays; override single cells via
+    over={'health.nonfinite': (s, c, value)} style tuples."""
+    rows = {
+        "health.grad_norm": np.full((1, S, C), 0.5),
+        "health.update_norm": np.full((1, S, C), 0.01),
+        "health.param_norm": np.full((1, S, C), 10.0),
+        "health.nonfinite": np.zeros((1, S, C)),
+    }
+    for key, (s, c, v) in over.items():
+        rows[key][0, s, c] = v
+    return rows
+
+
+def test_finite_round_no_trigger():
+    reg = MetricsRegistry()
+    mon = HealthMonitor(HealthConfig(), registry=reg)
+    assert mon.check(0, _rows(), [1.0]) is None
+    # histograms saw every (step, client) cell
+    assert reg.get("health.grad_norm").cell()["count"] == 12
+    assert reg.gauge("health.param_norm").value() == 10.0
+
+
+def test_nonfinite_trigger_names_the_cell():
+    mon = HealthMonitor(HealthConfig(), registry=MetricsRegistry())
+    rows = _rows(**{"health.nonfinite": (2, 3, 1)})
+    rows["health.update_norm"][0, 2, 3] = np.inf
+    trig = mon.check(5, rows, [1.0])
+    assert trig["kind"] == "nonfinite"
+    assert (trig["round"], trig["step"], trig["client"]) == (5, 2, 3)
+    assert trig["detail"]["health.update_norm"] == np.inf
+
+
+def test_outlier_client_flagged_not_triggering():
+    reg = MetricsRegistry()
+    mon = HealthMonitor(HealthConfig(outlier_k=3.0), registry=reg)
+    rows = _rows(S=2, C=4)
+    rows["health.update_norm"][0, :, 1] = 1.0  # 100x the 0.01 cohort norm
+    assert mon.check(0, rows, [1.0]) is None  # outliers warn, never abort
+    assert reg.counter("health.outlier_clients_total").value() == 1
+    assert reg.gauge("health.outlier_clients").value() == 1
+
+
+def test_loss_spike_trigger_after_window_fills():
+    cfg = HealthConfig(spike_factor=4.0, spike_window=3)
+    mon = HealthMonitor(cfg, registry=MetricsRegistry())
+    for loss in (1.0, 1.1, 0.9):  # fills the trailing window
+        assert mon.check(0, _rows(), [loss]) is None
+    trig = mon.check(3, _rows(), [40.0])
+    assert trig["kind"] == "loss_spike"
+    assert trig["round"] == 3 and trig["round_loss"] == 40.0
+    # spike_factor=0 disables the predicate entirely
+    mon2 = HealthMonitor(HealthConfig(spike_factor=0.0, spike_window=2),
+                         registry=MetricsRegistry())
+    for loss in (1.0, 1.0):
+        mon2.check(0, _rows(), [loss])
+    assert mon2.check(2, _rows(), [1e9]) is None
+
+
+def test_dp_clip_rate_gauge_matches_hand_computed_fraction():
+    """The satellite pin: a 4-example batch with known per-example global
+    norms (1, 1, 3, 5) against C=2 clips exactly 2 of 4 examples — the
+    published gauge must hold 0.5 EXACTLY, end to end through the DP-SGD
+    estimator's stats and the monitor's publication."""
+    import jax.numpy as jnp
+
+    from fedrec_tpu.privacy.dpsgd import per_example_clipped_grads
+
+    # loss(w, x) = w * x  =>  per-example grad = x, global norm = |x|
+    xs = jnp.asarray([1.0, -1.0, 3.0, 5.0])
+    loss, grads, stats = per_example_clipped_grads(
+        lambda w, x: w * x, jnp.asarray(1.0), (xs,), clip_norm=2.0,
+        with_stats=True,
+    )
+    assert float(stats["clip_rate"]) == 0.5
+    assert float(stats["max_norm"]) == 5.0
+    # clipped mean: (1 - 1 + 2*sign(3)... ) -> (1 - 1 + 2 + 2) / 4
+    assert float(grads) == pytest.approx(1.0)
+
+    reg = MetricsRegistry()
+    mon = HealthMonitor(HealthConfig(), registry=reg)
+    mon.publish_clip_rate(np.asarray(float(stats["clip_rate"])).reshape(1, 1, 1))
+    assert reg.gauge("privacy.clip_rate_last").value() == 0.5
+    assert reg.get("privacy.clip_rate").cell()["count"] == 1
+
+
+def test_clip_rate_rides_check_rows():
+    reg = MetricsRegistry()
+    mon = HealthMonitor(HealthConfig(), registry=reg)
+    rows = _rows(S=2, C=2)
+    rows["health.clip_rate"] = np.asarray([[[0.25, 0.75], [1.0, 0.5]]])
+    rows["health.clip_max_norm"] = np.asarray([[[3.0, 2.0], [9.0, 4.0]]])
+    mon.check(0, rows, [1.0])
+    assert reg.gauge("privacy.clip_rate_last").value() == 0.75  # last step mean
+    assert reg.get("privacy.clip_rate").cell()["count"] == 4
+    assert reg.gauge("privacy.max_grad_norm").value() == 9.0  # last step max
+
+
+def test_histogram_merge_counts_matches_observe_loop():
+    """The vectorized publish path (`merge_counts` fed by searchsorted)
+    lands every value in the same bucket a per-value observe() would —
+    including the inclusive upper bound and the +Inf overflow."""
+    reg = MetricsRegistry()
+    values = [0.05, 1.0, 1.0001, 7.3, 50.0, np.inf]
+    a = reg.histogram("loop", buckets=(1.0, 10.0))
+    for v in values:
+        a.observe(v)
+    b = reg.histogram("bulk", buckets=(1.0, 10.0))
+    from fedrec_tpu.obs.health import _observe_array
+
+    _observe_array(b, np.asarray(values))
+    ca, cb = a.cell(), b.cell()
+    assert ca["counts"] == cb["counts"]
+    assert ca["count"] == cb["count"] and ca["sum"] == cb["sum"]
+    with pytest.raises(ValueError):
+        b.merge_counts([1, 2], 0.0, 3)  # wrong bucket arity fails fast
+
+
+# ------------------------------------------------------------ flight recorder
+def _batch(i):
+    return {"candidates": np.full((2, 3), i), "labels": np.zeros(2)}
+
+
+def test_ring_bounds_and_dump_layout(tmp_path):
+    rec = FlightRecorder(ring_size=2)
+    rec.start_chunk(0, state_host=None, weights_by_round={0: np.ones(4)})
+    for s in range(5):
+        rec.record(_batch(s), round_idx=0, epoch_idx=0, step_idx=s)
+    reg = MetricsRegistry()
+    reg.counter("x").inc()
+    out = rec.dump(
+        tmp_path / "flightrec",
+        {"kind": "nonfinite", "round": 0, "step": 4, "client": 1},
+        registry=reg,
+        table=np.zeros((4, 2)),
+        meta={"num_news": 4, "title_len": 2, "mode": "joint"},
+    )
+    man = json.loads((out / "manifest.json").read_text())
+    # ring kept only the LAST 2 of 5 records, and says it dropped some
+    assert [r["step"] for r in man["records"]] == [3, 4]
+    assert man["ring_complete"] is False
+    assert man["offending"]["step"] == 4
+    assert man["weights"]["0"] == [1.0, 1.0, 1.0, 1.0]
+    assert (out / "registry.json").exists() and (out / "table.npy").exists()
+    batch = dict(np.load(out / man["offending"]["file"]))
+    assert batch["candidates"][0, 0] == 4  # the offending batch, bit-exact
+
+
+def test_dump_policy_first_suppresses_repeat(tmp_path):
+    rec = FlightRecorder(ring_size=2, dump_policy="first")
+    rec.start_chunk(0, None)
+    rec.record(_batch(0), 0, 0, 0)
+    assert rec.dump(tmp_path / "fr", {"kind": "nonfinite", "round": 0,
+                                      "step": 0}) is not None
+    assert rec.dump(tmp_path / "fr", {"kind": "nonfinite", "round": 1,
+                                      "step": 0}) is None
+
+
+def test_dump_policy_first_is_per_trigger_kind(tmp_path):
+    """An early loss-spike dump must NOT swallow the later non-finite
+    dump — the NaN forensics are the ones the operator needs, and the
+    spike-round state cannot replay the NaN round."""
+    rec = FlightRecorder(ring_size=2, dump_policy="first")
+    rec.start_chunk(0, None)
+    rec.record(_batch(0), 0, 0, 0)
+    spike = rec.dump(tmp_path / "fr", {"kind": "loss_spike", "round": 3,
+                                       "step": None})
+    assert spike is not None
+    nan = rec.dump(tmp_path / "fr", {"kind": "nonfinite", "round": 9,
+                                     "step": 0})
+    assert nan is not None and nan != spike
+    assert json.loads((nan / "manifest.json").read_text())[
+        "trigger"]["kind"] == "nonfinite"
+    # ...but a SECOND spike is still suppressed
+    assert rec.dump(tmp_path / "fr", {"kind": "loss_spike", "round": 12,
+                                      "step": None}) is None
+    rec2 = FlightRecorder(ring_size=2, dump_policy="all")
+    rec2.start_chunk(0, None)
+    rec2.record(_batch(0), 0, 0, 0)
+    d1 = rec2.dump(tmp_path / "fr2", {"kind": "nonfinite", "round": 0, "step": 0})
+    d2 = rec2.dump(tmp_path / "fr2", {"kind": "nonfinite", "round": 1, "step": 0})
+    assert d1 != d2 and d2.exists()
+
+
+def test_table_size_cap_skips_and_notes(tmp_path):
+    rec = FlightRecorder(ring_size=2, dump_table_max_mb=0)
+    rec.start_chunk(0, None)
+    rec.record(_batch(0), 0, 0, 0)
+    out = rec.dump(tmp_path / "fr", {"kind": "nonfinite", "round": 0, "step": 0},
+                   table=np.zeros((1000, 100)))
+    man = json.loads((out / "manifest.json").read_text())
+    assert man["table_file"] is None and "table_skipped_mb" in man
+
+
+# ----------------------------------------------------------------- rotation
+def test_rotate_jsonl_and_ordered_read(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    # ~40 bytes/record; cap at 0.0001 MB = 100 bytes -> rotates every ~3
+    max_mb = 0.0001
+    for i in range(30):
+        rotate_jsonl(p, max_mb)
+        with open(p, "a") as f:
+            f.write(json.dumps({"step": i, "v": i}) + "\n")
+    assert (tmp_path / "metrics.jsonl.1").exists()
+    records, _ = load_jsonl(p)
+    steps = [r["step"] for r in records]
+    # >= 2 rotations dropped the oldest records (the log is BOUNDED) but
+    # kept write ORDER across the .1/main seam, newest always retained
+    assert steps == sorted(steps)
+    assert steps[-1] == 29 and len(steps) < 30
+    # unbounded: no rotation
+    q = tmp_path / "m2.jsonl"
+    for i in range(5):
+        rotate_jsonl(q, 0)
+        with open(q, "a") as f:
+            f.write(json.dumps({"step": i}) + "\n")
+    assert not (tmp_path / "m2.jsonl.1").exists()
+    assert len(load_jsonl(q)[0]) == 5
+
+
+def test_metric_logger_rotation(tmp_path):
+    import io
+
+    from fedrec_tpu.utils.logging import MetricLogger
+
+    p = tmp_path / "metrics.jsonl"
+    logger = MetricLogger(stream=io.StringIO(), jsonl_path=str(p),
+                          registry=MetricsRegistry(), jsonl_max_mb=0.0001)
+    for i in range(12):
+        logger.log(i, {"round": i, "training_loss": 1.0 / (i + 1)})
+    logger.finish()
+    assert (tmp_path / "metrics.jsonl.1").exists()
+    records, _ = load_jsonl(p)
+    steps = [r["step"] for r in records]
+    assert steps == sorted(steps) and steps[-1] == 11

@@ -73,6 +73,51 @@ def test_histogram_quantile_from_snapshot_row():
     assert histogram_quantile({"count": 0, "buckets": {}}, 0.5) is None
 
 
+def test_quantile_all_mass_in_overflow_bucket_clamps_to_last_edge():
+    """The satellite pin: when every observation sits past the largest
+    finite bucket, the estimate is the last finite bucket EDGE (a lower
+    bound, flagged as such) — never inf, never a crash."""
+    import math
+
+    from fedrec_tpu.obs.registry import quantile_from_counts
+    from fedrec_tpu.obs.report import quantile_is_lower_bound
+
+    for q in (0.0, 0.5, 0.99, 1.0):
+        v = quantile_from_counts(q, (1.0, 10.0), [0, 0, 7])
+        assert v == 10.0 and math.isfinite(v)
+    row = {"count": 7, "sum": 700.0,
+           "buckets": {"1.0": 0, "10.0": 0, "+Inf": 7}}
+    assert histogram_quantile(row, 0.5) == 10.0
+    assert quantile_is_lower_bound(row, 0.5) is True
+    # mixed mass: p50 is a real estimate, p99 rank falls in overflow
+    mixed = {"count": 10, "sum": 0.0,
+             "buckets": {"1.0": 0, "10.0": 9, "+Inf": 1}}
+    assert quantile_is_lower_bound(mixed, 0.5) is False
+    assert quantile_is_lower_bound(mixed, 0.99) is True
+    # a live Histogram cell agrees with the exported-row path
+    from fedrec_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("overflowed", buckets=(1.0, 10.0))
+    for _ in range(7):
+        h.observe(500.0)
+    assert h.quantile(0.99) == 10.0
+
+
+def test_report_annotates_overflowed_percentiles_as_lower_bounds(tmp_path):
+    from fedrec_tpu.obs import MetricsRegistry
+    from fedrec_tpu.obs.report import render_text
+
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.latency_ms", buckets=(1.0, 10.0))
+    for _ in range(5):
+        h.observe(999.0)  # every request blew past the largest bucket
+    report = build_report([], [reg.snapshot()])
+    assert report["serving"]["p99_ms"] == 10.0
+    assert report["serving"]["p99_is_lower_bound"] is True
+    assert ">=10" in render_text(report).replace(" ", "").replace("ms", "")
+
+
 def test_cli_report_and_prom(artifact_dir, capsys):
     assert obs_main(["report", str(artifact_dir)]) == 0
     out = capsys.readouterr().out
@@ -89,3 +134,49 @@ def test_cli_report_and_prom(artifact_dir, capsys):
     assert 'serve_latency_ms_bucket{le="+Inf"} 4' in prom
 
     assert obs_main(["report", str(artifact_dir / "missing.jsonl")]) == 2
+
+
+def test_cli_missing_paths_fail_with_message_not_traceback(tmp_path, capsys):
+    """The satellite pin: a missing obs dir / artifact exits 2 with an
+    operator-grade stderr message — never a traceback."""
+    missing_dir = str(tmp_path / "never_ran")
+    for argv in (
+        ["report", missing_dir],
+        ["prom", missing_dir],
+        ["replay", missing_dir],
+        ["report", str(tmp_path / "nothing.jsonl")],
+        ["prom", str(tmp_path / "nothing.jsonl")],
+    ):
+        assert obs_main(argv) == 2, argv
+        err = capsys.readouterr().err
+        assert "fedrec-obs:" in err and "Traceback" not in err
+    # an explicit --trace that doesn't exist: same contract
+    empty = tmp_path / "d"
+    empty.mkdir()
+    (empty / "metrics.jsonl").write_text('{"step": 0}\n')
+    assert obs_main(["report", str(empty), "--trace",
+                     str(tmp_path / "no.json")]) == 2
+    # a CORRUPT trace degrades to a report without spans, not a crash
+    (empty / "trace.json").write_text("{torn")
+    assert obs_main(["report", str(empty)]) == 0
+    out = capsys.readouterr()
+    assert "skipping unreadable trace" in out.err
+
+
+def test_cli_report_reads_rotated_event_log(tmp_path, capsys):
+    """fedrec-obs report consumes metrics.jsonl.1 + metrics.jsonl in
+    write order (the obs.jsonl_max_mb rotation contract)."""
+    d = tmp_path / "obs"
+    d.mkdir()
+    (d / "metrics.jsonl.1").write_text(
+        '{"step": 0, "round": 0, "training_loss": 2.0, "elapsed_sec": 0}\n'
+    )
+    (d / "metrics.jsonl").write_text(
+        '{"step": 1, "round": 1, "training_loss": 1.0, "elapsed_sec": 5}\n'
+    )
+    assert obs_main(["report", str(d), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["training"]["rounds"] == 2
+    # first/last prove the rotated file was read FIRST
+    assert report["training"]["first_loss"] == 2.0
+    assert report["training"]["last_loss"] == 1.0
