@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 native obs-smoke
+.PHONY: t1 native obs-smoke chaos-smoke
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -11,6 +11,12 @@ t1:
 # artifact trio (metrics.jsonl / trace.json / prometheus.txt) renders
 obs-smoke:
 	@bash scripts/obs_smoke.sh
+
+# robustness smoke: seeded FaultPlan (dropout + nan + scale-poison) under
+# trimmed-mean aggregation — completes, reproduces bit-identically, and the
+# recovery leg quarantines + rolls back instead of aborting
+chaos-smoke:
+	@bash scripts/chaos_smoke.sh
 
 native:
 	$(MAKE) -C native

@@ -170,6 +170,14 @@ def build_service(args):
     return service, histories
 
 
+def make_histories(num_news: int, his_len: int, count: int = 256) -> list:
+    rng = np.random.default_rng(0)
+    return [
+        rng.integers(1, num_news, (rng.integers(3, his_len),)).tolist()
+        for _ in range(count)
+    ]
+
+
 async def run(args) -> dict:
     service, histories = build_service(args)
     service.warmup()
@@ -183,6 +191,34 @@ async def run(args) -> dict:
     )
     rows["service_metrics"] = service.metrics()
     await service.stop()
+    return rows
+
+
+async def run_remote(args) -> dict:
+    """Drive a LIVE ``fedrec-serve`` over TCP (``--connect host:port``)
+    through the resilient client pool: reconnect with exponential backoff
+    + jitter and per-request deadlines, so a server restart mid-load-run
+    degrades to elevated latency (and some error-counted requests) instead
+    of a crashed run and a lost artifact. Same closed/open loops as the
+    in-process mode — the pool presents the service's ``handle`` surface;
+    latency is the CLIENT-observed round trip."""
+    from fedrec_tpu.serving.client import ServingClientPool
+
+    host, port_s = args.connect.rsplit(":", 1)
+    pool = ServingClientPool(
+        host, int(port_s), size=max(args.clients, 4),
+        request_timeout_ms=args.request_timeout_ms,
+    )
+    histories = make_histories(args.num_news, args.his_len)
+    rows = {}
+    rows["closed"] = await closed_loop(pool, histories, args.clients, args.duration)
+    rows["open"] = await open_loop(
+        pool, histories, args.rate, args.duration, args.deadline_ms
+    )
+    metrics = await pool.admin("metrics", deadline_ms=5000.0)
+    rows["service_metrics"] = metrics.get("metrics", {"error": metrics.get("error")})
+    rows["client_retry"] = pool.retry_metrics()
+    await pool.close()
     return rows
 
 
@@ -200,6 +236,12 @@ def main() -> int:
     p.add_argument("--clients", type=int, default=32)
     p.add_argument("--rate", type=float, default=200.0, help="open-loop req/s")
     p.add_argument("--deadline-ms", type=float, default=100.0)
+    p.add_argument("--connect", default=None, metavar="HOST:PORT",
+                   help="drive a live fedrec-serve over TCP (resilient "
+                        "client: reconnect with backoff+jitter, per-request "
+                        "deadlines) instead of the in-process service")
+    p.add_argument("--request-timeout-ms", type=float, default=1000.0,
+                   help="closed-loop per-request deadline in --connect mode")
     p.add_argument("--duration", type=float, default=10.0, help="per-mode seconds")
     p.add_argument("--out", default="serve_load.json")
     p.add_argument("--obs-dir", default=None,
@@ -214,9 +256,10 @@ def main() -> int:
 
     # span recording only pays off when --obs-dir will save the trace
     get_tracer().enabled = bool(args.obs_dir)
-    rows = asyncio.run(run(args))
+    rows = asyncio.run(run_remote(args) if args.connect else run(args))
     out = {
         "metric": "serving_load",
+        "transport": f"tcp:{args.connect}" if args.connect else "inproc",
         "num_news": args.num_news,
         "his_len": args.his_len,
         "top_k": args.top_k,
@@ -228,7 +271,13 @@ def main() -> int:
         **rows,
         "provenance": provenance(),
     }
-    write_artifact(Path(__file__).with_name(args.out), out, partial=False)
+    # bare filenames land next to this script (the banked-artifact home);
+    # an explicit path (absolute or with directories) is honored as given
+    out_path = (
+        Path(args.out) if Path(args.out).parent != Path(".")
+        else Path(__file__).with_name(args.out)
+    )
+    write_artifact(out_path, out, partial=False)
     if args.obs_dir:
         from fedrec_tpu.obs import dump_artifacts
 

@@ -24,12 +24,107 @@ Runs standalone too (single process): degrades to local FedAvg.
 
 from __future__ import annotations
 
+import os
 import sys
 from pathlib import Path
 
 import numpy as np
 
 from fedrec_tpu.cli.run import build_parser
+
+# EX_TEMPFAIL: a supervised worker's "world broken, relaunch me" status —
+# the supervisor respawns the FULL distributed invocation, which
+# re-rendezvouses and resumes from local snapshots (the elastic path)
+RESPAWN_EXIT = 75
+
+
+def _supervise(argv: list[str]) -> int:
+    """``--supervise``: wrap the worker in an auto-respawn loop.
+
+    The worker runs as a child process; whenever it dies abnormally — a
+    crash/kill (negative returncode), or the deliberate
+    :data:`RESPAWN_EXIT` a supervised worker uses when its world breaks —
+    the supervisor relaunches the identical invocation after a jittered
+    backoff. Every relaunch re-rendezvouses at the same coordinator
+    address and resumes from the local snapshots (counter negotiation +
+    ``sync_from_server`` integrate even a worker that never saved), so a
+    killed peer turns test_elastic's manual stop-the-world restart story
+    into zero operator actions: run every host with ``--supervise`` and
+    the run finishes.
+
+    The first respawn waits about the worker's ``--collective-timeout``:
+    the surviving peers need that long to notice the broken world, exit
+    with :data:`RESPAWN_EXIT` themselves, and free the coordination
+    service address for the new world. ``FEDREC_SUPERVISE_MAX`` (default
+    20) bounds the respawn budget; ``FEDREC_WORKER_PIDFILE`` (if set)
+    receives the live worker's pid, so chaos tooling can kill it.
+    """
+    import random
+    import subprocess
+    import time
+
+    keep = [t for t in argv if t != "--supervise"]
+    env = dict(os.environ, FEDREC_SUPERVISED="1")
+    pidfile = os.environ.get("FEDREC_WORKER_PIDFILE")
+    base_delay = 5.0
+    for i, tok in enumerate(keep):
+        val = None
+        if tok == "--collective-timeout" and i + 1 < len(keep):
+            val = keep[i + 1]
+        elif tok.startswith("--collective-timeout="):
+            val = tok.split("=", 1)[1]
+        if val is not None:
+            try:
+                base_delay = max(2.0, min(float(val), 30.0))
+            except ValueError:
+                pass
+    max_respawns = int(os.environ.get("FEDREC_SUPERVISE_MAX", "20"))
+    rng = random.Random(os.getpid())
+    attempt = 0
+    while True:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fedrec_tpu.cli.coordinator", *keep],
+            env=env,
+        )
+        if pidfile:
+            try:
+                Path(pidfile).write_text(str(proc.pid))
+            except OSError:
+                pass
+        rc = proc.wait()
+        if rc == 0:
+            if attempt:
+                print(f"[supervisor] worker finished after {attempt} respawn(s)")
+            return 0
+        # only RETRYABLE statuses respawn: a signal/crash (rc < 0), the
+        # deliberate RESPAWN_EXIT a supervised worker uses for a broken
+        # world (which also covers rendezvous races — see main()), or the
+        # chaos kill's os._exit(137). A deterministic failure (config
+        # error rc=1, argparse rc=2) would fail identically 20 times —
+        # surface it immediately instead.
+        if rc > 0 and rc not in (RESPAWN_EXIT, 137):
+            print(
+                f"[supervisor] worker exited rc={rc} (non-retryable); "
+                "not respawning",
+                flush=True,
+            )
+            return rc
+        attempt += 1
+        if attempt > max_respawns:
+            print(
+                f"[supervisor] giving up after {max_respawns} respawns "
+                f"(last rc={rc})",
+                flush=True,
+            )
+            return rc if rc > 0 else 1
+        delay = min(base_delay * (1.5 ** min(attempt - 1, 6)), 60.0)
+        delay *= 0.5 + rng.random()  # jitter: desynchronize peer supervisors
+        print(
+            f"[supervisor] worker exited rc={rc}; respawn "
+            f"{attempt}/{max_respawns} in {delay:.1f}s",
+            flush=True,
+        )
+        time.sleep(delay)
 
 
 def apply_process_sharding(cfg, rt, server_trains: bool) -> None:
@@ -70,8 +165,16 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--resume-local-state", default=None, metavar="PATH",
                         help="internal: resume standalone from a per-process "
                              "msgpack state (degraded-mode respawn)")
+    parser.add_argument("--supervise", action="store_true",
+                        help="run the worker under an auto-respawn "
+                             "supervisor: a died/killed worker (or a broken "
+                             "world) relaunches and rejoins through the "
+                             "elastic resume path without operator action")
     original_argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
+    if args.supervise:
+        return _supervise(original_argv)
+    supervised = os.environ.get("FEDREC_SUPERVISED") == "1"
 
     from fedrec_tpu.parallel.multihost import (
         CoordinatorRuntime,
@@ -79,7 +182,29 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     if args.coordinator is not None:
-        initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+        # supervised relaunches get a BOUNDED rendezvous: a respawn racing
+        # the old (dying) world must fail fast and let the supervisor retry
+        init_timeout = None
+        if supervised and args.collective_timeout:
+            init_timeout = max(30.0, min(args.collective_timeout * 2, 120.0))
+        try:
+            initialize_distributed(
+                args.coordinator, args.num_processes, args.process_id,
+                initialization_timeout=init_timeout,
+            )
+        except Exception as e:  # noqa: BLE001 — supervised rendezvous
+            # failures are RETRYABLE by definition (a respawn racing the
+            # dying world); exit with the retryable status so the
+            # supervisor relaunches, instead of rc=1 (non-retryable)
+            if not supervised:
+                raise
+            print(
+                f"[coordinator] supervised rendezvous failed "
+                f"({type(e).__name__}: {e}); exiting for retry "
+                f"(rc {RESPAWN_EXIT})",
+                flush=True,
+            )
+            sys.exit(RESPAWN_EXIT)
 
     import jax
 
@@ -99,9 +224,19 @@ def main(argv: list[str] | None = None) -> int:
     cfg.fed.num_clients = args.clients or len(jax.local_devices())
     cfg.apply_overrides(args.overrides)
 
+    if cfg.fed.robust.method != "mean" and cfg.fed.dcn_compress != "none":
+        # fail FAST (same policy as validate_compress): raised lazily inside
+        # the aggregation collective, this would be misread by the watchdog
+        # as a peer failure and silently degrade every host to standalone
+        raise ValueError(
+            f"fed.robust.method={cfg.fed.robust.method!r} requires "
+            "fed.dcn_compress='none' (robust reduction over quantized "
+            "contributions would trim rounding noise, not clients)"
+        )
     rt = CoordinatorRuntime(
         collective_timeout_s=args.collective_timeout or None,
         compress=cfg.fed.dcn_compress,
+        robust=cfg.fed.robust,
     )
     apply_process_sharding(cfg, rt, args.server_trains)
 
@@ -182,13 +317,31 @@ def main(argv: list[str] | None = None) -> int:
         )
         if cfg.train.resume and local_snap.exists():
             template = {"state": trainer.state, "round": 0}
-            restored = serialization.from_bytes(template, local_snap.read_bytes())
-            trainer.adopt_state(restored["state"])
-            trainer.start_round = int(restored["round"]) + 1
-            print(
-                f"[coordinator] process {rt.process_id} resumed local state "
-                f"at round {trainer.start_round - 1}"
-            )
+            try:
+                restored = serialization.from_bytes(
+                    template, local_snap.read_bytes()
+                )
+                from fedrec_tpu.train.checkpoint import verify_state_tree
+
+                verify_state_tree(restored["state"])
+            except Exception as e:  # noqa: BLE001 — a torn/corrupt snapshot
+                # must not kill the resume: this shard restarts fresh and is
+                # re-integrated by the server's round negotiation + fan-out
+                # (the same path a brand-new elastic host takes)
+                print(
+                    f"[coordinator] process {rt.process_id} local snapshot "
+                    f"{local_snap.name} is corrupt/torn "
+                    f"({type(e).__name__}: {e}); starting this shard fresh — "
+                    "the server's fan-out re-integrates it next round"
+                )
+                restored = None
+            if restored is not None:
+                trainer.adopt_state(restored["state"])
+                trainer.start_round = int(restored["round"]) + 1
+                print(
+                    f"[coordinator] process {rt.process_id} resumed local state "
+                    f"at round {trainer.start_round - 1}"
+                )
         if cfg.fed.server_opt != "none":
             # cross-host FedOpt is hub-and-spoke: ONLY the server holds and
             # steps the optimizer (the FedOpt paper's topology); clients
@@ -237,11 +390,28 @@ def main(argv: list[str] | None = None) -> int:
         The round in flight when the world broke is simply re-trained
         standalone. The SERVER owns the coordination service and finishes
         degraded in-process (finalize's os._exit skips broken teardown).
-        """
-        if rt.is_server or rt.num_processes == 1 or local_snap is None:
-            return
-        import os
 
+        Under a supervisor (``--supervise``) the policy changes: every
+        degraded process — server included — exits device-free with
+        RESPAWN_EXIT so its supervisor relaunches the full distributed
+        invocation; the relaunched world re-rendezvouses and resumes from
+        local snapshots. The server's exit is what frees the coordination
+        service address for the new world.
+        """
+        if rt.num_processes == 1:
+            return
+        if supervised:
+            print(
+                f"[coordinator] process {rt.process_id} world degraded "
+                f"under supervision — exiting for re-rendezvous "
+                f"(rc {RESPAWN_EXIT})",
+                flush=True,
+            )
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(RESPAWN_EXIT)
+        if rt.is_server or local_snap is None:
+            return
         world_flags = {"--coordinator", "--num-processes", "--process-id",
                        "--collective-timeout", "--resume-local-state"}
         keep: list[str] = []
@@ -284,6 +454,30 @@ def main(argv: list[str] | None = None) -> int:
         if server_round < 0:
             break
         round_idx = server_round
+        # host-level chaos fault: deterministic peer kill at round entry —
+        # the surviving peers block in the next collective until their
+        # watchdogs degrade them (supervised: the whole world relaunches).
+        # Marker-guarded so the resumed/relaunched world doesn't re-die
+        # when it re-reaches the same round.
+        if (
+            cfg.chaos.enabled
+            and cfg.chaos.kill_round == round_idx
+            and cfg.chaos.kill_process == rt.process_id
+        ):
+            marker_dir = (
+                snapshot_dir if msgpack_snapshots
+                else Path(cfg.train.snapshot_dir or "snapshots")
+            )
+            marker_dir.mkdir(parents=True, exist_ok=True)
+            marker = marker_dir / f"chaos_killed_p{rt.process_id}"
+            if not marker.exists():
+                marker.write_text(str(round_idx))
+                print(
+                    f"[chaos] process {rt.process_id} dying at round "
+                    f"{round_idx} (chaos.kill_round)",
+                    flush=True,
+                )
+                os._exit(137)
         # server fan-out: everyone adopts the global model
         u0, n0 = trainer._client0_params()
         u, n = rt.sync_from_server((u0, n0))
@@ -294,7 +488,11 @@ def main(argv: list[str] | None = None) -> int:
 
         result = None
         if trains:
-            result = trainer.train_round(round_idx)
+            # train_round_recovering: identical to train_round unless
+            # fed.robust.recover, which quarantines/rolls back IN-host;
+            # cross-host, a quarantined cohort still reports its (robust)
+            # local aggregate — host-level exclusion is participation
+            result = trainer.train_round_recovering(round_idx)
 
         # gather: participation weight 0 for a non-training server; with
         # fed.weight_by_samples each client counts by its shard size
@@ -358,6 +556,20 @@ def main(argv: list[str] | None = None) -> int:
                         {"state": trainer.state, "round": round_idx}
                     ),
                 )
+                if (
+                    cfg.chaos.enabled
+                    and cfg.chaos.torn_snapshot_round == round_idx
+                ):
+                    # host-level chaos fault: simulate a crash mid-write by
+                    # truncating the snapshot we just wrote — the resume
+                    # path must survive it (fresh shard + server fan-out)
+                    blob = local_snap.read_bytes()
+                    local_snap.write_bytes(blob[: max(len(blob) // 2, 1)])
+                    print(
+                        f"[chaos] process {rt.process_id} tore its local "
+                        f"snapshot at round {round_idx}",
+                        flush=True,
+                    )
                 if server_optimizer is not None:
                     # server-only state (hub-and-spoke FedOpt), round-tagged
                     atomic_write_bytes(

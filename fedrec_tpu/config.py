@@ -156,6 +156,46 @@ class OptimConfig:
 
 
 @dataclass
+class RobustConfig:
+    """Byzantine-robust aggregation + quarantine/rollback recovery.
+
+    ``method`` selects the round-end aggregator (``fedrec_tpu.fed.robust``),
+    compiled INTO the same shard_map program as the plain FedAvg sync so it
+    composes with DP noise (applied per client, pre-sync) and FedOpt server
+    optimizers (which step the post-aggregation global):
+
+      * "mean"         — participation-weighted mean (FedAvg; the default,
+                         bit-identical to pre-robust behavior)
+      * "clip"         — norm-clipped mean: each client's deviation from the
+                         coordinate-wise cohort median is clipped to
+                         ``clip_norm`` (global L2 over both towers) before
+                         the weighted mean; non-finite contributions clip
+                         to zero. Bounds any one client's round influence
+                         by clip_norm / num_participants.
+      * "trimmed_mean" — coordinate-wise: drop the ``trim_k`` highest and
+                         lowest finite participant values per coordinate,
+                         mean the rest (unweighted over kept participants)
+      * "median"       — coordinate-wise median over finite participants
+
+    ``recover`` turns the PR-4 health sentry's detection into reaction:
+    on a non-finite update or an outlier client (round-mean update-norm >
+    ``obs.health.outlier_k`` x cohort median) the Trainer quarantines the
+    client (participation weight 0 for ``quarantine_rounds`` rounds),
+    rolls the cohort back to the round-entry state, and replays the round
+    — up to ``max_retries`` distinct quarantines per round, then the
+    existing flight-recorder abort. A quarantined client rejoins healed:
+    params reset to the global, optimizer moments zeroed.
+    """
+
+    method: str = "mean"               # "mean" | "clip" | "trimmed_mean" | "median"
+    trim_k: int = 1                    # coords trimmed from EACH end (trimmed_mean)
+    clip_norm: float = 10.0            # global-L2 clip for method="clip"
+    recover: bool = False              # quarantine + rollback instead of abort
+    quarantine_rounds: int = 3         # rounds a flagged client sits out
+    max_retries: int = 2               # rollback/replay attempts per round
+
+
+@dataclass
 class FedConfig:
     """Federation strategy (reference modes a-d, SURVEY.md section 0)."""
 
@@ -191,6 +231,11 @@ class FedConfig:
     # "int8" = symmetric per-tensor quantization (4x the wire, zero-mean
     # rounding noise on the round mean; fan-out stays full precision)
     dcn_compress: str = "none"         # "none" | "int8"
+    # Byzantine-robust aggregation + quarantine/rollback recovery (see
+    # RobustConfig). Applies wherever params aggregate: the in-graph
+    # round-end sync (param_avg, host-driven AND rounds-in-jit) and the
+    # coordinator's cross-host gather.
+    robust: RobustConfig = field(default_factory=RobustConfig)
 
 
 @dataclass
@@ -285,6 +330,39 @@ class ObsConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Deterministic fault injection (``fedrec_tpu.fed.chaos``).
+
+    A seeded :class:`FaultPlan` schedules per-round, per-client faults.
+    Client-side faults are applied as masks at the optimizer-update
+    boundary INSIDE the jitted step (the per-client fault vector rides the
+    batch as ``chaos.code``/``chaos.scale`` arrays, so every dispatch mode
+    — per-batch, epoch scan, rounds-in-jit — and the flight-recorder
+    replay see identical faults), and two runs of the same plan are
+    bit-identical. Host-level faults (peer kill, torn snapshot) exercise
+    the coordinator deployment's recovery paths.
+
+    ``faults`` is a comma list of ``kind@round:client[xscale]`` specs,
+    ``round`` may be ``*`` (every round):
+
+        nan@2:3          client 3's round-2 updates become NaN
+        scale@*:5x100    client 5's updates x100 every round (poison)
+        flip@4:2         client 2's round-4 updates sign-flipped
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    drop_rate: float = 0.0             # per-(round, client) Bernoulli dropout
+    straggle_rate: float = 0.0         # ditto; weight 0 + optional host delay
+    straggle_ms: float = 0.0           # host-driven path: sleep per straggler round
+    faults: str = ""                   # "kind@round:client[xscale]" comma list
+    # host faults (coordinator deployment only):
+    kill_round: int = -1               # process exits hard at this round's entry
+    kill_process: int = -1             #   which coordinator process dies
+    torn_snapshot_round: int = -1      # truncate the just-written local snapshot
+
+
+@dataclass
 class TrainConfig:
     total_epochs: int = 10
     save_every: int = 1                # snapshot cadence (reference main.py argv)
@@ -343,6 +421,7 @@ class ExperimentConfig:
     privacy: PrivacyConfig = field(default_factory=PrivacyConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     # ------------------------------------------------------------------ io
     def to_dict(self) -> dict[str, Any]:

@@ -7,13 +7,30 @@ from fedrec_tpu.fed.strategies import (
     participation_mask,
     weighted_param_avg,
 )
+from fedrec_tpu.fed.robust import (
+    ROBUST_METHODS,
+    robust_aggregate,
+    robust_reduce_np,
+    robust_reduce_tree_np,
+    validate_robust_method,
+)
+from fedrec_tpu.fed.chaos import FAULT_CODES, FaultPlan, RoundFaults, parse_faults
 
 __all__ = [
+    "FAULT_CODES",
+    "FaultPlan",
     "FedStrategy",
     "GradAvg",
     "Local",
     "ParamAvg",
+    "ROBUST_METHODS",
+    "RoundFaults",
     "get_strategy",
+    "parse_faults",
     "participation_mask",
+    "robust_aggregate",
+    "robust_reduce_np",
+    "robust_reduce_tree_np",
+    "validate_robust_method",
     "weighted_param_avg",
 ]

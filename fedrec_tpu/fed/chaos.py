@@ -1,0 +1,158 @@
+"""Deterministic fault injection: a seeded plan of client & host faults.
+
+At production scale client failure is the steady state, not the exception
+(FedJAX, arxiv 2108.02117, treats client subsampling/failure as a
+first-class simulation primitive) — so the robustness machinery needs a
+way to be *exercised*, reproducibly. A :class:`FaultPlan` is a pure
+function of ``(chaos config, round index)``: the same plan produces the
+same faults on every run and on every rollback replay, so
+
+* a chaos run is bit-identical when re-run (the acceptance bar for the
+  chaos smoke), and
+* the Trainer's quarantine/rollback replay re-encounters the exact fault
+  it rolled back from, proving the quarantine — not luck — saved the
+  round.
+
+Client-side faults come in two flavors:
+
+* **participation faults** (``drop``, ``straggle``): the client's round
+  weight is forced to 0 — it trains but its contribution is excluded,
+  exactly the failure mode the reference dies on
+  (Final_Report.pdf VII.a). Stragglers can additionally cost a host-side
+  delay (``straggle_ms``) on the host-driven path.
+* **update faults** (``nan``, ``scale``, ``flip``): applied as masks at
+  the optimizer-update boundary INSIDE the jitted step. The per-client
+  ``(code, scale)`` vectors ride the batch dict as ``chaos.code`` /
+  ``chaos.scale`` arrays, so every dispatch mode (per-batch, epoch scan,
+  rounds-in-jit) compiles the same fault arithmetic, and the flight
+  recorder's batch ring captures them — ``fedrec-obs replay`` re-injects
+  the fault for free.
+
+Host-level faults (``kill_round``/``kill_process``, guarded by an
+on-disk marker so a resumed world doesn't re-die; ``torn_snapshot_round``)
+live in the coordinator CLI, which reads the same config section.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# update-fault codes carried in the batch's chaos.code vector; 0 = none
+FAULT_CODES = {"nan": 1, "scale": 2, "flip": 3}
+
+
+@dataclass(frozen=True)
+class RoundFaults:
+    """One round's resolved faults (pure function of plan + round)."""
+
+    weight_mask: np.ndarray            # (C,) float32 0/1 — drop+straggle
+    codes: np.ndarray                  # (C,) int32 update-fault codes
+    scales: np.ndarray                 # (C,) float32 (code==scale multiplier)
+    dropped: tuple = ()
+    straggled: tuple = ()
+    injected: tuple = ()               # ((kind, client), ...) update faults
+
+    @property
+    def any(self) -> bool:
+        return bool(
+            self.dropped or self.straggled or self.injected
+        )
+
+
+def parse_faults(spec: str, num_clients: int) -> list[tuple[str, int | None, int, float]]:
+    """Parse the ``faults`` DSL: comma list of ``kind@round:client[xscale]``
+    (``round`` may be ``*`` = every round). Raises on malformed entries so a
+    typo'd plan fails at build time, not silently fault-free."""
+    out: list[tuple[str, int | None, int, float]] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        try:
+            kind, rest = item.split("@", 1)
+            round_s, client_s = rest.split(":", 1)
+            scale = 1.0
+            if "x" in client_s:
+                client_s, scale_s = client_s.split("x", 1)
+                scale = float(scale_s)
+            rnd = None if round_s == "*" else int(round_s)
+            client = int(client_s)
+        except ValueError:
+            raise ValueError(
+                f"chaos.faults entry {item!r} is not "
+                "'kind@round:client[xscale]' (e.g. 'nan@2:3,scale@*:5x100')"
+            ) from None
+        if kind not in FAULT_CODES:
+            raise ValueError(
+                f"chaos.faults entry {item!r}: unknown kind {kind!r}; "
+                f"expected one of {sorted(FAULT_CODES)}"
+            )
+        if not 0 <= client < num_clients:
+            raise ValueError(
+                f"chaos.faults entry {item!r}: client {client} out of range "
+                f"[0, {num_clients})"
+            )
+        out.append((kind, rnd, client, scale))
+    return out
+
+
+class FaultPlan:
+    """Seeded, deterministic per-round fault schedule.
+
+    ``round_faults(r)`` is idempotent: the random drop/straggle draws are
+    derived from ``default_rng([seed, r])``, never from mutable state, so
+    rollback replays and re-runs see identical faults.
+    """
+
+    def __init__(self, chaos_cfg: Any, num_clients: int):
+        self.cfg = chaos_cfg
+        self.num_clients = int(num_clients)
+        self.seed = int(chaos_cfg.seed)
+        self.drop_rate = float(chaos_cfg.drop_rate)
+        self.straggle_rate = float(chaos_cfg.straggle_rate)
+        self.specs = parse_faults(chaos_cfg.faults, self.num_clients)
+
+    def round_faults(self, round_idx: int) -> RoundFaults:
+        c = self.num_clients
+        mask = np.ones((c,), np.float32)
+        dropped: list[int] = []
+        straggled: list[int] = []
+        if self.drop_rate > 0 or self.straggle_rate > 0:
+            rng = np.random.default_rng([self.seed, int(round_idx)])
+            u = rng.random(c)
+            # one draw decides both: [0, drop) drops, [drop, drop+straggle)
+            # straggles — so the rates compose without double-failing
+            for i in range(c):
+                if u[i] < self.drop_rate:
+                    dropped.append(i)
+                    mask[i] = 0.0
+                elif u[i] < self.drop_rate + self.straggle_rate:
+                    straggled.append(i)
+                    mask[i] = 0.0
+        codes = np.zeros((c,), np.int32)
+        scales = np.ones((c,), np.float32)
+        injected: list[tuple[str, int]] = []
+        for kind, rnd, client, scale in self.specs:
+            if rnd is not None and rnd != round_idx:
+                continue
+            codes[client] = FAULT_CODES[kind]
+            scales[client] = np.float32(scale)
+            injected.append((kind, client))
+        return RoundFaults(
+            weight_mask=mask,
+            codes=codes,
+            scales=scales,
+            dropped=tuple(dropped),
+            straggled=tuple(straggled),
+            injected=tuple(injected),
+        )
+
+    def batch_keys(self, round_idx: int) -> dict[str, np.ndarray]:
+        """The per-client fault vectors a chaos-enabled step expects in
+        every batch dict (``train.step`` applies them at the update
+        boundary)."""
+        rf = self.round_faults(round_idx)
+        return {"chaos.code": rf.codes, "chaos.scale": rf.scales}

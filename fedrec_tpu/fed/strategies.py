@@ -114,12 +114,21 @@ def weighted_param_avg(params: Any, weight: jnp.ndarray, axis: str) -> Any:
     mirroring the coordinator broadcast (reference ``server.py:76-77``).
     A round where NO client reports keeps everyone's local parameters
     (rather than dividing by zero into NaN).
+
+    Zero-weight contributions are masked out of the sum, not multiplied
+    in: a quarantined/faulted client whose parameters are NaN must
+    contribute nothing — ``NaN * 0`` would still be NaN and poison every
+    participant (``fedrec_tpu.fed.robust``). For finite params this is
+    bit-identical to the plain ``psum(p * w)``.
     """
     total = lax.psum(weight, axis_name=axis)
     safe_total = jnp.where(total > 0, total, 1.0)
     return jax.tree_util.tree_map(
         lambda p: jnp.where(
-            total > 0, lax.psum(p * weight, axis_name=axis) / safe_total, p
+            total > 0,
+            lax.psum(jnp.where(weight > 0, p * weight, 0.0), axis_name=axis)
+            / safe_total,
+            p,
         ),
         params,
     )

@@ -116,6 +116,11 @@ class HealthMonitor:
         self._loss_window: deque[float] = deque(
             maxlen=max(int(getattr(health_cfg, "spike_window", 8)), 1)
         )
+        # outliers found by the most recent check() (post-ignore): the
+        # Trainer's quarantine/rollback path reads this — an outlier alone
+        # is not a dump trigger, but under fed.robust.recover it is a
+        # quarantine trigger
+        self.last_outliers: list[dict] = []
 
     # ------------------------------------------------------------ publish
     def publish_clip_rate(self, clip_rates: np.ndarray) -> None:
@@ -136,6 +141,7 @@ class HealthMonitor:
         start_round: int,
         rows: Mapping[str, np.ndarray],
         round_losses: list[float],
+        ignore_clients: set[int] | None = None,
     ) -> dict | None:
         """Digest one round's (or chunk's) health arrays.
 
@@ -144,7 +150,16 @@ class HealthMonitor:
         instruments and returns a trigger dict (``kind`` ∈ {"nonfinite",
         "loss_spike"}) or None.  Non-finite wins over a spike — it is the
         root-cause signal.
+
+        ``ignore_clients`` (the Trainer's quarantine set) suppresses
+        triggers AND outlier flags from those clients: a quarantined
+        client's weight is already 0, so its (expected) bad numbers must
+        not re-trigger the rollback it caused — and must not pollute the
+        cohort median other clients are judged against.  The outlier list
+        of the last check (post-ignore) is kept on ``self.last_outliers``
+        for the recovery path.
         """
+        ignore = ignore_clients or set()
         arrays = {
             k: np.asarray(v, np.float64) for k, v in rows.items() if v is not None
         }
@@ -169,31 +184,49 @@ class HealthMonitor:
                     float(np.max(mx.reshape(-1, mx.shape[-1])[-1]))
                 )
 
-        # ---- outlier clients: round-mean update norm vs cohort median
+        # ---- outlier clients: round-mean update norm vs cohort median.
+        # The median spans only eligible (non-ignored) clients with FINITE
+        # norms: one NaN client would otherwise NaN the median and hide
+        # every real outlier in the same round.
         k = float(getattr(self.cfg, "outlier_k", 0.0) or 0.0)
         outliers: list[dict] = []
         if upd is not None and k > 0 and upd.ndim == 3 and upd.shape[-1] >= 2:
+            eligible = np.array(
+                [c not in ignore for c in range(upd.shape[-1])], bool
+            )
             for r in range(upd.shape[0]):
                 per_client = upd[r].mean(axis=0)  # (clients,)
-                med = float(np.median(per_client))
+                base = per_client[eligible & np.isfinite(per_client)]
+                if base.size < 2:
+                    continue
+                med = float(np.median(base))
                 if med > 0 and np.isfinite(med):
                     for c in np.nonzero(per_client > k * med)[0]:
+                        if not eligible[c]:
+                            continue
                         outliers.append({
                             "round": start_round + r,
                             "client": int(c),
                             "update_norm": float(per_client[c]),
                             "cohort_median": med,
                         })
+        self.last_outliers = outliers
         if outliers:
             self._c_outliers.inc(len(outliers))
         self._g_outliers.set(float(len(set(
             (o["round"], o["client"]) for o in outliers
         ))))
 
-        # ---- non-finite sentinel
+        # ---- non-finite sentinel (counter counts EVERY bad cell; the
+        # trigger comes from the first cell of a non-ignored client)
         nf = arrays.get("health.nonfinite")
         if nf is not None and nf.sum() > 0:
             self._c_nonfinite.inc(float(nf.sum()))
+            nf = nf.copy()
+            for c in ignore:
+                if 0 <= c < nf.shape[-1]:
+                    nf[..., c] = 0
+        if nf is not None and nf.sum() > 0:
             r, s, c = (int(i[0]) for i in np.nonzero(nf))
             detail = {
                 key: float(arrays[key][r, s, c])

@@ -276,6 +276,35 @@ def build_report(
         if health:
             report["health"] = health
 
+        # ---- robustness: chaos faults, quarantines/rollbacks, the
+        # robust-aggregation method in use (label of the per-round counter)
+        rb: dict[str, Any] = {}
+        faults = {
+            row["labels"].get("kind", "?"): row["value"]
+            for row in _metric_values(last, "chaos.faults_total")
+            if "value" in row
+        }
+        if faults:
+            rb["faults_injected"] = faults
+        for key, name in (
+            ("quarantines", "fed.quarantines_total"),
+            ("rollbacks", "fed.rollbacks_total"),
+            ("quarantine_active", "fed.quarantine_active"),
+        ):
+            v = snapshot_value(last, name)
+            if v:
+                rb[key] = v
+        methods = {
+            row["labels"].get("method", "?"): row["value"]
+            for row in _metric_values(last, "fed.robust_rounds_total")
+            if "value" in row
+        }
+        if methods:
+            rb["robust_method"] = max(methods, key=methods.get)
+            rb["robust_rounds"] = sum(methods.values())
+        if rb:
+            report["robustness"] = rb
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -372,6 +401,29 @@ def render_text(report: dict) -> str:
                 f"xla compiles: {int(hl['xla_compiles'])} "
                 f"(recompiles: {int(hl.get('xla_recompiles', 0))}, "
                 f"storms: {int(hl.get('recompile_storms', 0))})"
+            )
+        lines.append("")
+    rb = report.get("robustness")
+    if rb:
+        lines.append("## Robustness")
+        if "robust_method" in rb:
+            lines.append(
+                f"aggregation: {rb['robust_method']} "
+                f"({int(rb.get('robust_rounds', 0))} rounds)"
+            )
+        if "faults_injected" in rb:
+            lines.append(
+                "faults injected: "
+                + ", ".join(
+                    f"{k}={int(v)}"
+                    for k, v in sorted(rb["faults_injected"].items())
+                )
+            )
+        if "quarantines" in rb or "rollbacks" in rb:
+            lines.append(
+                f"clients quarantined: {int(rb.get('quarantines', 0))}, "
+                f"rollbacks: {int(rb.get('rollbacks', 0))}, "
+                f"active: {int(rb.get('quarantine_active', 0))}"
             )
         lines.append("")
     if "cap_overflow_steps" in report:

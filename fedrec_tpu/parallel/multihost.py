@@ -25,6 +25,7 @@ reference instead hangs until its 2-day gloo timeout if any client dies
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -39,6 +40,7 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    initialization_timeout: float | None = None,
 ) -> tuple[int, int]:
     """Join the multi-host world; returns (process_id, num_processes).
 
@@ -60,11 +62,43 @@ def initialize_distributed(
         jax.config.update("jax_enable_recoverability", True)
     except AttributeError:  # older jax without the flag: keep prior behavior
         pass
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    # Backend must not be touched before jax.distributed.initialize, so key
+    # off the requested platform rather than jax.default_backend().
+    platforms = os.environ.get("JAX_PLATFORMS", "") or str(
+        getattr(jax.config, "jax_platforms", None) or ""
     )
+    first = platforms.split(",")[0].strip().lower()
+    if first in ("cpu", ""):
+        # XLA:CPU has no native multiprocess collectives ("Multiprocess
+        # computations aren't implemented on the CPU backend") — route them
+        # through gloo so CPU worlds (a default-backend CPU host as much
+        # as an explicit JAX_PLATFORMS=cpu one; test_elastic,
+        # test_supervisor) exercise the real cross-process path. With an
+        # accelerator present ("" resolves to tpu/gpu) the setting is
+        # inert: it only selects the CPU backend's collectives impl.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # older jax / no gloo build
+            pass
+    kwargs: dict = {}
+    if initialization_timeout:
+        # bounded bring-up for supervised relaunches: a respawn racing a
+        # dying world must FAIL (and be retried by its supervisor) rather
+        # than sit in jax's default 5-minute rendezvous wait
+        kwargs["initialization_timeout"] = int(initialization_timeout)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except TypeError:  # older jax without initialization_timeout
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     return jax.process_index(), jax.process_count()
 
 
@@ -129,7 +163,11 @@ def dequantize_weighted_mean(
 
 
 def aggregate_from_hosts(
-    params: Any, weight: float = 1.0, compress: str = "none", base: Any = None
+    params: Any,
+    weight: float = 1.0,
+    compress: str = "none",
+    base: Any = None,
+    robust: Any = None,
 ) -> Any:
     """Participation-weighted FedAvg across processes.
 
@@ -145,6 +183,15 @@ def aggregate_from_hosts(
     model would bias every client's training, while quantizing the per-round
     CONTRIBUTIONS only adds zero-mean rounding noise to the mean.
 
+    ``robust`` (a ``fed.robust`` config section with ``method != "mean"``)
+    swaps the weighted mean for a Byzantine-robust reduction
+    (:func:`fedrec_tpu.fed.robust.robust_reduce_tree_np`) applied to the
+    (P, ...) stacks ``process_allgather`` already materializes — the
+    cross-HOST counterpart of the in-graph cohort aggregators, so a
+    poisoned *process* cannot move the coordinator's global either.
+    Robust methods require ``compress='none'``: trimming per coordinate
+    after int8 rounding would judge quantization noise, not clients.
+
     ``base`` (int8 mode only): a pytree every process holds identically —
     the round-start global from the server fan-out. When given, the round
     DELTAS ``params - base`` are quantized instead of the absolute tensors
@@ -157,6 +204,34 @@ def aggregate_from_hosts(
     """
     validate_compress(compress)
     w_arr = np.asarray(weight, np.float32)
+    method = getattr(robust, "method", "mean") if robust is not None else "mean"
+    if method != "mean":
+        from fedrec_tpu.fed.robust import (
+            robust_reduce_tree_np,
+            validate_robust_method,
+        )
+
+        validate_robust_method(method)
+        if compress != "none":
+            raise ValueError(
+                f"fed.robust.method={method!r} requires "
+                "fed.dcn_compress='none': coordinate-wise robust reduction "
+                "over int8-quantized contributions would trim quantization "
+                "noise, not clients"
+            )
+        raw = jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32), params)
+        gathered, weights = multihost_utils.process_allgather((raw, w_arr))
+        if float(np.sum(weights)) == 0.0:
+            return params  # nobody reported; keep local (no NaNs)
+        reduced = robust_reduce_tree_np(
+            gathered, np.asarray(weights), method,
+            trim_k=robust.trim_k, clip_norm=robust.clip_norm,
+            fallback_tree=raw,  # m==0 coordinates keep local (in-graph parity)
+        )
+        return jax.tree_util.tree_map(
+            lambda m, p: jnp.asarray(np.asarray(m, np.asarray(p).dtype)),
+            reduced, params,
+        )
     if compress == "int8":
         flat, treedef = jax.tree_util.tree_flatten(params)
         if base is not None:
@@ -231,11 +306,13 @@ class CoordinatorRuntime:
         self,
         collective_timeout_s: float | None = None,
         compress: str = "none",
+        robust: Any = None,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
         self.collective_timeout_s = collective_timeout_s
         self.compress = validate_compress(compress)
+        self.robust = robust  # fed.robust section; None/mean = plain FedAvg
         self.degraded = False
         self._shutdown_done = False
         if self.num_processes > 1:
@@ -317,7 +394,8 @@ class CoordinatorRuntime:
         w = float(weight) if participated else 0.0
         return self._collective(
             lambda: aggregate_from_hosts(
-                params, w, compress=self.compress, base=base
+                params, w, compress=self.compress, base=base,
+                robust=self.robust,
             ),
             lambda: params,
         )

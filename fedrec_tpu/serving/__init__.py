@@ -21,6 +21,11 @@ long-lived online service:
 """
 
 from fedrec_tpu.serving.batcher import Backpressure, MicroBatcher, ServedResult
+from fedrec_tpu.serving.client import (
+    ServingClient,
+    ServingClientPool,
+    ServingUnavailable,
+)
 from fedrec_tpu.serving.retrieval import (
     TwoStageIndex,
     build_index,
@@ -38,7 +43,10 @@ __all__ = [
     "Generation",
     "MicroBatcher",
     "ServedResult",
+    "ServingClient",
+    "ServingClientPool",
     "ServingService",
+    "ServingUnavailable",
     "TwoStageIndex",
     "build_index",
     "build_two_stage_fn",

@@ -1,0 +1,252 @@
+"""Resilient TCP client for the serving protocol — retry, backoff, deadlines.
+
+The serving wire protocol (``fedrec_tpu.serving.server``) is JSON lines
+over TCP. Driving it with a bare ``asyncio.open_connection`` makes every
+consumer — the load generator, an admin refresh script, a smoke test —
+fall over the moment the server restarts: one ``ConnectionResetError``
+and the whole run's artifact is gone. This module is the one place that
+failure handling lives:
+
+* :class:`ServingClient` — a single connection that (re)connects lazily
+  with **exponential backoff + full jitter** (delay ~ U(0, base·2^n),
+  capped), enforces a **per-request deadline** (default
+  ``request_timeout_ms``; per-call ``deadline_ms`` wins), and converts
+  transport failures into error *responses* (``{"error": "deadline"}`` /
+  ``{"error": "unavailable"}``) instead of exceptions — so a server
+  restart mid-run degrades to elevated latency, not a crashed driver.
+  A timed-out request closes the connection (the response stream is no
+  longer line-synchronized) and the next call reconnects.
+* :class:`ServingClientPool` — N independent connections behind an
+  ``asyncio`` queue with the same ``handle(request)`` surface as the
+  in-process :class:`~fedrec_tpu.serving.server.ServingService`, so
+  ``benchmarks/serve_load.py --connect host:port`` drives a live server
+  with the exact closed/open-loop code that drives the in-process one.
+  ``latency_ms``/``deadline_met`` are overwritten with the CLIENT-side
+  round trip — the honest number once a network sits in the middle.
+
+Also the admin client: ``admin("metrics")``, ``admin("prometheus")``,
+``admin("refresh", snapshot_dir=..., token_states=...)`` — see
+docs/OPERATIONS.md for the one-liner.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from typing import Any
+
+
+class ServingUnavailable(ConnectionError):
+    """Raised by :meth:`ServingClient.request_or_raise` when the retry
+    budget is exhausted; the plain ``request`` surface returns an error
+    response instead."""
+
+
+class ServingClient:
+    """One JSON-lines connection with reconnect/backoff and deadlines.
+
+    One request in flight per client (callers needing concurrency use a
+    :class:`ServingClientPool`); the response to a request is the next
+    line, so a lost or timed-out request invalidates the stream and the
+    connection is dropped and re-established.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        request_timeout_ms: float = 1000.0,
+        backoff_base_ms: float = 50.0,
+        backoff_max_ms: float = 2000.0,
+        max_attempts: int = 8,
+        seed: int | None = None,
+    ):
+        self.host = host
+        self.port = int(port)
+        self.request_timeout_ms = float(request_timeout_ms)
+        self.backoff_base_ms = float(backoff_base_ms)
+        self.backoff_max_ms = float(backoff_max_ms)
+        self.max_attempts = int(max_attempts)
+        self._rng = random.Random(seed)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._was_connected = False
+        # observable retry accounting (the load generator reports these)
+        self.reconnects = 0
+        self.failed_requests = 0
+
+    # ------------------------------------------------------------ plumbing
+    def backoff_delay_s(self, attempt: int) -> float:
+        """Full-jitter exponential backoff (AWS-style): U(0, min(cap,
+        base·2^attempt)). Jitter matters as much as the exponent — a
+        restarted server must not meet every client's retry in one
+        synchronized stampede."""
+        cap = min(self.backoff_max_ms, self.backoff_base_ms * (2 ** attempt))
+        return self._rng.uniform(0.0, cap) / 1e3
+
+    async def _drop(self) -> None:
+        w, self._reader, self._writer = self._writer, None, None
+        if w is not None:
+            try:
+                w.close()
+                await w.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _connect(self, deadline: float) -> bool:
+        """(Re)connect with backoff until ``deadline`` (monotonic seconds)
+        or the attempt budget runs out. True on success."""
+        for attempt in range(self.max_attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self.host, self.port),
+                    timeout=remaining,
+                )
+                # any re-establishment after a previous connection counts —
+                # a drop followed by a clean first-attempt re-dial is still
+                # a reconnect in the artifact's resilience accounting
+                if self._was_connected:
+                    self.reconnects += 1
+                self._was_connected = True
+                return True
+            except (OSError, asyncio.TimeoutError):
+                await self._drop()
+                delay = self.backoff_delay_s(attempt)
+                if time.monotonic() + delay >= deadline:
+                    return False
+                await asyncio.sleep(delay)
+        return False
+
+    # ------------------------------------------------------------ requests
+    async def request(self, payload: dict, deadline_ms: float | None = None) -> dict:
+        """One request/response with retry inside the deadline.
+
+        Returns the server's response dict, or ``{"error": "deadline"}`` /
+        ``{"error": "unavailable"}`` when the deadline passed or every
+        reconnect attempt failed — never raises for transport failures.
+        """
+        budget_ms = deadline_ms if deadline_ms is not None else self.request_timeout_ms
+        deadline = time.monotonic() + budget_ms / 1e3
+        line = (json.dumps(payload) + "\n").encode()
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.failed_requests += 1
+                return {"error": "deadline"}
+            if self._writer is None and not await self._connect(deadline):
+                self.failed_requests += 1
+                return {"error": "unavailable"}
+            try:
+                self._writer.write(line)
+                await asyncio.wait_for(
+                    self._writer.drain(), deadline - time.monotonic()
+                )
+                raw = await asyncio.wait_for(
+                    self._reader.readline(), max(deadline - time.monotonic(), 0)
+                )
+            except asyncio.TimeoutError:
+                # the stream is no longer line-synchronized; drop it
+                await self._drop()
+                self.failed_requests += 1
+                return {"error": "deadline"}
+            except (ConnectionError, OSError):
+                # server went away mid-request (restart): reconnect and
+                # retry while the deadline allows
+                await self._drop()
+                delay = self.backoff_delay_s(attempt)
+                attempt += 1
+                if time.monotonic() + delay >= deadline:
+                    self.failed_requests += 1
+                    return {"error": "unavailable"}
+                await asyncio.sleep(delay)
+                continue
+            if not raw:  # clean EOF: server closed on us — retry like a reset
+                await self._drop()
+                delay = self.backoff_delay_s(attempt)
+                attempt += 1
+                if time.monotonic() + delay >= deadline:
+                    self.failed_requests += 1
+                    return {"error": "unavailable"}
+                await asyncio.sleep(delay)
+                continue
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError:
+                await self._drop()
+                self.failed_requests += 1
+                return {"error": "bad_response"}
+
+    async def request_or_raise(
+        self, payload: dict, deadline_ms: float | None = None
+    ) -> dict:
+        resp = await self.request(payload, deadline_ms=deadline_ms)
+        if resp.get("error") in ("deadline", "unavailable"):
+            raise ServingUnavailable(
+                f"{self.host}:{self.port} — {resp['error']}"
+            )
+        return resp
+
+    async def admin(self, cmd: str, deadline_ms: float | None = None, **kw) -> dict:
+        """Admin command (``metrics`` / ``prometheus`` / ``refresh``) —
+        refreshes load a checkpoint and recompile, so give them a real
+        deadline (e.g. ``deadline_ms=120_000``)."""
+        return await self.request({"cmd": cmd, **kw}, deadline_ms=deadline_ms)
+
+    async def close(self) -> None:
+        await self._drop()
+
+
+class ServingClientPool:
+    """N :class:`ServingClient` connections behind a checkout queue,
+    presenting the in-process service's ``handle(request)`` surface."""
+
+    def __init__(self, host: str, port: int, size: int = 8, **client_kw):
+        self.clients = [
+            ServingClient(host, port, seed=i, **client_kw) for i in range(size)
+        ]
+        self._q: asyncio.Queue = asyncio.Queue()
+        for c in self.clients:
+            self._q.put_nowait(c)
+
+    async def handle(self, req: dict) -> dict:
+        cli = await self._q.get()
+        try:
+            t0 = time.perf_counter()
+            deadline_ms = req.get("deadline_ms")
+            resp = await cli.request(req, deadline_ms=deadline_ms)
+            rtt_ms = (time.perf_counter() - t0) * 1e3
+            if "error" not in resp:
+                # client-observed latency replaces the server's own number:
+                # with a network (and reconnects) in the path, the RTT is
+                # the truth the load artifact must carry
+                resp["latency_ms"] = rtt_ms
+                resp["deadline_met"] = (
+                    rtt_ms <= deadline_ms if deadline_ms else True
+                )
+            return resp
+        finally:
+            self._q.put_nowait(cli)
+
+    async def admin(self, cmd: str, deadline_ms: float | None = None, **kw) -> dict:
+        cli = await self._q.get()
+        try:
+            return await cli.admin(cmd, deadline_ms=deadline_ms, **kw)
+        finally:
+            self._q.put_nowait(cli)
+
+    def retry_metrics(self) -> dict:
+        return {
+            "connections": len(self.clients),
+            "reconnects": sum(c.reconnects for c in self.clients),
+            "failed_requests": sum(c.failed_requests for c in self.clients),
+        }
+
+    async def close(self) -> None:
+        for c in self.clients:
+            await c.close()

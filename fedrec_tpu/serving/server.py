@@ -89,6 +89,7 @@ class ServingService:
         )
         self._fns: dict[int, Any] = {}
         self._latencies: deque[float] = deque(maxlen=latency_window)
+        self._conns: set = set()  # live TCP writers; closed on stop()
         self._started_at = time.time()
         # derived gauges refreshed lazily at snapshot/exposition time (a
         # registry collector): percentile math per scrape, not per request
@@ -122,6 +123,17 @@ class ServingService:
 
     async def stop(self) -> None:
         await self.batcher.stop()
+        # close surviving connections: a stopped service answering
+        # "batcher not started" errors forever would pin well-behaved
+        # retrying clients (fedrec_tpu.serving.client) to a dead endpoint —
+        # an explicit close makes them back off and reconnect to whatever
+        # replaces us
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self._conns.clear()
         # one final refresh so post-stop exposition/artifact dumps carry the
         # service's last numbers, then detach: a stopped service must not
         # keep publishing through the process registry (tests build many
@@ -318,6 +330,7 @@ class ServingService:
 async def _handle_conn(service: ServingService, reader, writer) -> None:
     write_lock = asyncio.Lock()
     tasks: set[asyncio.Task] = set()
+    service._conns.add(writer)
 
     async def one(raw: bytes) -> None:
         try:
@@ -357,6 +370,7 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
             t.add_done_callback(tasks.discard)
     if tasks:
         await asyncio.gather(*tasks, return_exceptions=True)
+    service._conns.discard(writer)
     try:
         writer.close()
         await writer.wait_closed()

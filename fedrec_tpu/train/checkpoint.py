@@ -16,7 +16,43 @@ from pathlib import Path
 from typing import Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+
+class SnapshotIntegrityError(RuntimeError):
+    """A restored snapshot failed verification (non-finite parameters):
+    the on-disk bytes parsed but the state is not trainable."""
+
+
+def verify_state_tree(state: Any, samples_per_leaf: int = 256) -> None:
+    """Integrity check on a restored state pytree: a strided sample of
+    every float PARAMETER leaf must be finite.  Params only — a healthy
+    quarantine-era snapshot may legitimately carry non-finite optimizer
+    moments for an excluded client, but non-finite *parameters* can never
+    be right (every client adopts the finite aggregate at round end).
+    Raises :class:`SnapshotIntegrityError` on the first bad leaf."""
+    subtrees = []
+    for name in ("user_params", "news_params"):
+        sub = getattr(state, name, None)
+        if sub is None and isinstance(state, dict):
+            sub = state.get(name)
+        if sub is not None:
+            subtrees.append((name, sub))
+    if not subtrees:  # unknown layout: check everything float
+        subtrees = [("state", state)]
+    for name, sub in subtrees:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(sub)[0]:
+            arr = np.asarray(leaf)
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            flat = arr.reshape(-1)
+            stride = max(1, flat.size // samples_per_leaf)
+            if not np.isfinite(flat[::stride]).all():
+                raise SnapshotIntegrityError(
+                    f"non-finite values in restored {name}"
+                    f"{jax.tree_util.keystr(path)}"
+                )
 
 
 class SnapshotManager:
@@ -26,6 +62,10 @@ class SnapshotManager:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
+        # the round restore() actually landed on — may be OLDER than
+        # latest_round() when the newest snapshot was corrupt/torn and
+        # restore fell back to a previous retained one
+        self.last_restored_round: int | None = None
 
     def _settled_step(self, round_idx: int | None) -> int | None:
         """The one reader-side settle point: waits out any in-flight async
@@ -59,15 +99,67 @@ class SnapshotManager:
             raise FileNotFoundError(f"no snapshot under {self.directory}")
         return self.manager.restore(step, args=ocp.args.StandardRestore())
 
-    def restore(self, state_template: Any, round_idx: int | None = None) -> Any:
-        """Restore into the structure of ``state_template`` (shapes/dtypes)."""
+    def restore(
+        self,
+        state_template: Any,
+        round_idx: int | None = None,
+        verify: bool = True,
+    ) -> Any:
+        """Restore into the structure of ``state_template`` (shapes/dtypes).
+
+        Integrity-checked: the loaded pytree is verified (structure via the
+        template restore itself; finite-ness of a sampled subset of every
+        parameter leaf via :func:`verify_state_tree`).  When the LATEST
+        snapshot is corrupt or torn — a crash mid-write, a truncated file,
+        a bad disk — restore falls back to the previous retained snapshot
+        instead of crashing the resume; ``self.last_restored_round``
+        records which round actually loaded (callers must resume from
+        ``last_restored_round + 1``, not ``latest_round() + 1``).  An
+        explicit ``round_idx`` disables the fallback (the caller asked for
+        that exact snapshot).
+        """
         step = self._settled_step(round_idx)
         if step is None:
             raise FileNotFoundError(f"no snapshot under {self.directory}")
         abstract = jax.tree_util.tree_map(
             ocp.utils.to_shape_dtype_struct, state_template
         )
-        return self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        if round_idx is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(
+                (s for s in self.manager.all_steps() if s <= step), reverse=True
+            ) or [step]
+        last_err: Exception | None = None
+        for s in candidates:
+            try:
+                out = self.manager.restore(
+                    s, args=ocp.args.StandardRestore(abstract)
+                )
+                if verify:
+                    verify_state_tree(out)
+                self.last_restored_round = int(s)
+                if s != candidates[0]:
+                    print(
+                        f"[checkpoint] fell back to the round-{s} snapshot "
+                        f"(newest at round {candidates[0]} is corrupt: "
+                        f"{type(last_err).__name__})"
+                    )
+                return out
+            except Exception as e:  # noqa: BLE001 — each retained snapshot
+                # gets its chance; the LAST error is re-raised below
+                last_err = e
+                print(
+                    f"[checkpoint] snapshot at round {s} failed to "
+                    f"restore/verify ({type(e).__name__}: {e}); "
+                    + ("trying the previous retained snapshot"
+                       if s != candidates[-1] else "no older snapshot left")
+                )
+        raise RuntimeError(
+            f"every retained snapshot under {self.directory} failed to "
+            f"restore (rounds {candidates}); the checkpoint directory is "
+            "unusable — point train.snapshot_dir somewhere fresh"
+        ) from last_err
 
     def wait(self) -> None:
         """Settle in-flight async saves (call before process exit)."""

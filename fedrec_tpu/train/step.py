@@ -100,6 +100,24 @@ def _restack(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: x[None], tree)
 
 
+def _apply_update_fault(tree: Any, code: jnp.ndarray, scale: jnp.ndarray) -> Any:
+    """Chaos update-fault mask at the optimizer-update boundary.
+
+    ``code`` is this client's scalar fault code (``fed.chaos.FAULT_CODES``:
+    0 none, 1 nan, 2 scale, 3 sign-flip) and ``scale`` the multiplier for
+    code 2 — both ride the batch dict so every dispatch mode (and the
+    flight-recorder replay) compiles identical fault arithmetic. Code 0
+    selects the original update untouched (exact, not ``u * 1``).
+    """
+
+    def one(u):
+        factor = jnp.where(code == 3, -1.0, scale).astype(u.dtype)
+        faulted = jnp.where(code == 1, jnp.full_like(u, jnp.nan), u * factor)
+        return jnp.where(code == 0, u, faulted)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 # vmap axis name for the in-device client cohort (num_clients > devices):
 # cross-client collectives then run over (LOCAL_AXIS, mesh_axis) jointly, so
 # "average over all clients" means exactly that regardless of how clients
@@ -568,6 +586,17 @@ def _build_local_step(
     # the host with the round's losses, so a silent NaN or a divergent
     # client is visible without a blocking readback per step
     sentry = cfg.obs.health.sentry
+    # deterministic fault injection (fed.chaos): per-client update-fault
+    # vectors ride the batch as chaos.code/chaos.scale and apply at the
+    # update boundary below — same compiled arithmetic in every dispatch
+    # mode, bit-identical across runs of the same FaultPlan
+    chaos = cfg.chaos.enabled
+    if chaos and n_seq > 1:
+        raise NotImplementedError(
+            "chaos fault injection with fed.seq_shards > 1 is not supported "
+            "(the seq-parallel batch spec does not carry the per-client "
+            "fault vectors); run the plan with seq_shards=1"
+        )
 
     def local_step(state: ClientState, batch: dict, table: jnp.ndarray):
         # trace-time cap resolution: each compiled per-client batch shape
@@ -711,6 +740,13 @@ def _build_local_step(
             sentry_grads = (user_g, news_g)
             user_g = strategy.sync_grads(user_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
+            if chaos:
+                # fault AT the update boundary: the sentry below sees the
+                # faulted update, so detection (and the quarantine path)
+                # fires exactly as it would on a real bad client
+                u_updates = _apply_update_fault(
+                    u_updates, batch["chaos.code"], batch["chaos.scale"]
+                )
             n_updates = None
             if news_g is None:
                 new_news_params, opt_news = state.news_params, state.opt_news
@@ -719,6 +755,10 @@ def _build_local_step(
                 n_updates, opt_news = opt_news_tx.update(
                     news_g, state.opt_news, state.news_params
                 )
+                if chaos:
+                    n_updates = _apply_update_fault(
+                        n_updates, batch["chaos.code"], batch["chaos.scale"]
+                    )
                 new_news_params = jax.tree_util.tree_map(
                     lambda p, u: p + u, state.news_params, n_updates
                 )
@@ -770,6 +810,10 @@ def _build_local_step(
 
             user_g = strategy.sync_grads(user_g, sync_axes)
             u_updates, opt_user = opt_user_tx.update(user_g, state.opt_user, state.user_params)
+            if chaos:
+                u_updates = _apply_update_fault(
+                    u_updates, batch["chaos.code"], batch["chaos.scale"]
+                )
             sentry_updates = (u_updates,)
             new_state = state.replace(
                 step=state.step + 1,
@@ -1010,7 +1054,7 @@ def build_fed_round_scan(
         model, cfg, strategy, mesh, mode, noise_fn
     )
     _, sync_axes = cohort_axes(cfg, mesh)
-    local_round_sync = _make_local_sync(strategy, sync_axes)
+    local_round_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust)
 
     @partial(
         shard_map,
@@ -1115,12 +1159,39 @@ def build_news_update_step(
     return jax.jit(sharded_update, donate_argnums=(0,))
 
 
-def _make_local_sync(strategy: FedStrategy, sync_axes: Any) -> Callable:
+def _make_local_sync(
+    strategy: FedStrategy, sync_axes: Any, robust: Any = None
+) -> Callable:
     """THE round-end parameter-sync body — shared by ``build_param_sync``
     (host-driven rounds) and ``build_fed_round_scan`` (rounds-in-jit) so
     the two programs can never diverge on what a round-end sync means.
     Optimizer states stay local (the reference likewise only averages
-    parameters)."""
+    parameters).
+
+    ``robust`` (a ``fed.robust`` config section) swaps the weighted mean
+    for a Byzantine-robust aggregator when ``method != "mean"`` — both
+    towers aggregate as ONE tree so the clip method's global norm spans
+    the whole client update (``fedrec_tpu.fed.robust``). Strategies that
+    never sync params (local/grad_avg) stay untouched.
+    """
+    method = getattr(robust, "method", "mean") if robust is not None else "mean"
+    if method != "mean" and strategy.sync_params_every_round:
+        from fedrec_tpu.fed.robust import robust_aggregate, validate_robust_method
+
+        validate_robust_method(method)
+
+        def local_sync(state: ClientState, w: jnp.ndarray):
+            new_user, new_news = robust_aggregate(
+                (state.user_params, state.news_params),
+                w,
+                sync_axes,
+                method=method,
+                trim_k=robust.trim_k,
+                clip_norm=robust.clip_norm,
+            )
+            return state.replace(user_params=new_user, news_params=new_news)
+
+        return local_sync
 
     def local_sync(state: ClientState, w: jnp.ndarray):
         new_user = strategy.sync_params(state.user_params, w, sync_axes)
@@ -1145,7 +1216,7 @@ def build_param_sync(
     axis = cfg.fed.mesh_axis
     strategy = strategy or ParamAvg()
     k, sync_axes = cohort_axes(cfg, mesh)
-    local_sync = _make_local_sync(strategy, sync_axes)
+    local_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust)
 
     @partial(
         shard_map,

@@ -80,6 +80,20 @@ class RoundResult:
     val_metrics: dict[str, float] = field(default_factory=dict)
 
 
+class RoundRecovery(Exception):
+    """Internal control flow for quarantine-and-rollback recovery
+    (``fed.robust.recover``): raised by the round-end health check instead
+    of the hard abort, caught by ``Trainer.run``, which quarantines the
+    offending client, restores the round-entry state, and replays."""
+
+    def __init__(self, trigger: dict):
+        super().__init__(
+            f"recoverable health trigger [{trigger.get('kind')}] "
+            f"client {trigger.get('client')} round {trigger.get('round')}"
+        )
+        self.trigger = trigger
+
+
 class Trainer:
     """Federated trainer over a clients mesh.
 
@@ -99,6 +113,43 @@ class Trainer:
         self.data = data
         self.model = NewsRecommender(cfg.model)
         self.strategy = get_strategy(cfg.fed.strategy)
+        # ---- robustness (fed.robust + chaos): validate up front — a robust
+        # method or recovery mode that would silently never apply is a
+        # misconfiguration, not a preference (same policy as server_opt)
+        from fedrec_tpu.fed.robust import validate_robust_method
+
+        rb = cfg.fed.robust
+        validate_robust_method(rb.method)
+        if rb.method != "mean" and not self.strategy.sync_params_every_round:
+            raise ValueError(
+                f"fed.robust.method={rb.method!r} requires a strategy that "
+                "syncs params every round (param_avg or coordinator); "
+                f"fed.strategy={cfg.fed.strategy!r} never aggregates params, "
+                "so the robust aggregator would silently never run"
+            )
+        if rb.recover:
+            if not self.strategy.sync_params_every_round:
+                raise ValueError(
+                    "fed.robust.recover=true requires a param-syncing "
+                    "strategy (param_avg or coordinator): quarantine works "
+                    "by zeroing the client's aggregation weight"
+                )
+            if not cfg.obs.health.sentry:
+                raise ValueError(
+                    "fed.robust.recover=true requires obs.health.sentry: "
+                    "recovery is driven by the in-graph health vectors"
+                )
+        self.chaos = None
+        if cfg.chaos.enabled:
+            from fedrec_tpu.fed.chaos import FaultPlan
+
+            self.chaos = FaultPlan(cfg.chaos, cfg.fed.num_clients)
+        # quarantine ledger: client -> rounds left excluded; retries count
+        # rollback/replay attempts for the CURRENT round (reset on advance)
+        self._quarantine: dict[int, int] = {}
+        self._round_retries = 0
+        self._recovery_state = None
+        self._recovery_opt_state = None
         self.server_opt = None
         if cfg.fed.server_opt != "none":
             if not self.strategy.sync_params_every_round:
@@ -276,7 +327,13 @@ class Trainer:
                         "train.snapshot_dir at a fresh directory to start "
                         "over."
                     ) from e
-                self.start_round = int(self.snapshots.latest_round()) + 1
+                # last_restored_round, not latest_round(): a corrupt newest
+                # snapshot falls back to the previous retained one, and the
+                # resumed counter must match the state that actually loaded
+                restored = self.snapshots.last_restored_round
+                if restored is None:
+                    restored = int(self.snapshots.latest_round())
+                self.start_round = int(restored) + 1
                 print(f"[trainer] resumed from snapshot at round {self.start_round - 1}")
                 if self.server_opt is not None:
                     # FedOpt buffers live host-side; restore the sidecar so
@@ -379,6 +436,32 @@ class Trainer:
             "train.cap_overflow_total",
             "unique-news cap overflow count (client-summed over steps; "
             "nonzero aborts the round)",
+        )
+        # ---- robustness instruments (fedrec-obs report's Robustness
+        # section reads these): always registered — zero-valued when the
+        # features are off, so the section simply doesn't render
+        self._m_robust_rounds = self.registry.counter(
+            "fed.robust_rounds_total",
+            "round-end aggregations performed, labeled by robust method",
+            labels=("method",),
+        )
+        self._m_quarantines = self.registry.counter(
+            "fed.quarantines_total",
+            "clients quarantined by the recovery path (weight 0 for "
+            "fed.robust.quarantine_rounds rounds)",
+        )
+        self._m_rollbacks = self.registry.counter(
+            "fed.rollbacks_total",
+            "round rollback/replay cycles performed by the recovery path",
+        )
+        self._g_quarantined = self.registry.gauge(
+            "fed.quarantine_active", "clients currently quarantined"
+        )
+        self._m_chaos = self.registry.counter(
+            "chaos.faults_total",
+            "faults injected by the chaos FaultPlan, labeled by kind "
+            "(drop/straggle/nan/scale/flip); rollback replays re-count",
+            labels=("kind",),
         )
         # spent-epsilon trajectory: one gauge per round, next to loss/AUC.
         # Only the rigorous mechanism gets a trajectory — ldp_news carries
@@ -676,13 +759,15 @@ class Trainer:
         return self._table
 
     # ------------------------------------------------------------------
-    def _epoch_batch_iter(self, epoch_idx: int):
+    def _epoch_batch_iter(self, epoch_idx: int, extra: dict | None = None):
         """Epoch batches as step-ready dicts, built ahead on a bounded
         producer thread when ``data.prefetch_batches`` > 0 — batch t+1
         assembles (shuffle, negative sampling, packing) while step t runs
         on device, closing the dispatch gap the step_profile host-pipeline
         rows measure. Off (0) = plain inline iteration, identical batches
-        either way (tests/test_prefetch.py)."""
+        either way (tests/test_prefetch.py). ``extra`` (the round's chaos
+        fault vectors) is merged into every batch dict."""
+        extra = extra or {}
         return maybe_prefetch(
             self.batcher.epoch_batches_sharded(
                 self.cfg.fed.num_clients, epoch_idx
@@ -692,6 +777,7 @@ class Trainer:
                 "candidates": b.candidates,
                 "history": b.history,
                 "labels": b.labels,
+                **extra,
             },
         )
 
@@ -750,7 +836,38 @@ class Trainer:
             return
         if not arrays:
             return
-        trigger = self.health.check(start_round, arrays, list(round_losses))
+        trigger = self.health.check(
+            start_round, arrays, list(round_losses),
+            ignore_clients=set(self._quarantine),
+        )
+        # ---- quarantine-and-rollback (fed.robust.recover): a non-finite
+        # update or an outlier client becomes a RECOVERABLE trigger while
+        # retries remain — run() quarantines the client, restores the
+        # round-entry state, and replays. Quarantined clients were already
+        # excluded above, so a replay cannot re-trigger on the same client;
+        # retries bound how many DISTINCT bad clients one round may shed
+        # before the existing dump-and-abort takes over.
+        rb = self.cfg.fed.robust
+        if rb.recover:
+            cand = (
+                trigger
+                if trigger is not None and trigger.get("kind") == "nonfinite"
+                else None
+            )
+            if cand is None and self.health.last_outliers:
+                cand = {
+                    "kind": "outlier",
+                    **max(
+                        self.health.last_outliers,
+                        key=lambda o: o["update_norm"],
+                    ),
+                }
+            if (
+                cand is not None
+                and cand.get("client") is not None
+                and self._round_retries < rb.max_retries
+            ):
+                raise RoundRecovery(cand)
         if trigger is None:
             return
         dump_dir = self._dump_flightrec(trigger)
@@ -784,6 +901,135 @@ class Trainer:
         if kind == "nonfinite" and self.cfg.obs.health.abort_on_nonfinite:
             raise TrainingHealthError(msg)
         print(f"[trainer] WARNING: {msg}")
+
+    # ------------------------------------------- quarantine & rollback
+    def train_round_recovering(self, round_idx: int) -> RoundResult:
+        """One host-driven round under the quarantine/rollback policy —
+        the coordinator driver's per-round entry point (``run`` applies
+        the same policy around whole chunks). Without
+        ``fed.robust.recover`` this is exactly :meth:`train_round`."""
+        while True:
+            self._capture_recovery_state()
+            try:
+                result = self.train_round(round_idx)
+            except RoundRecovery as e:
+                self._rollback_and_quarantine(e.trigger, round_idx)
+                continue
+            self._round_retries = 0
+            self._tick_quarantine()
+            return result
+
+    def _capture_recovery_state(self) -> None:
+        """Snapshot the rollback target at round/chunk entry: the full
+        client state (host copy), plus the FedOpt buffers — the server
+        optimizer steps at round end, so replaying a rolled-back round
+        without restoring them would double-apply momentum."""
+        if not self.cfg.fed.robust.recover:
+            return
+        self._recovery_state = self._host_state()
+        if self.server_opt is not None:
+            import copy
+
+            self._recovery_opt_state = copy.deepcopy(self.server_opt._state)
+
+    def _rollback_and_quarantine(self, trigger: dict, round_idx: int) -> None:
+        """Apply one recovery cycle (``fed.robust.recover``): quarantine the
+        offending client, restore the round-entry state, and let ``run``
+        replay the round. Published to the registry and stamped into the
+        trace as a ``rollback`` event; the replayed round's ``fed_round``
+        span carries the active quarantine set."""
+        cfg = self.cfg
+        client = int(trigger["client"])
+        kind = str(trigger.get("kind"))
+        self._round_retries += 1
+        self._quarantine[client] = max(
+            self._quarantine.get(client, 0), cfg.fed.robust.quarantine_rounds
+        )
+        self._m_quarantines.inc()
+        self._m_rollbacks.inc()
+        self._g_quarantined.set(float(len(self._quarantine)))
+        self.tracer.add_span(
+            "rollback", dur_s=0.0,
+            round=int(trigger.get("round") or round_idx),
+            client=client, kind=kind, retry=self._round_retries,
+        )
+        print(
+            f"[trainer] WARNING: health trigger [{kind}] on client {client} "
+            f"at round {trigger.get('round')} — quarantining it for "
+            f"{cfg.fed.robust.quarantine_rounds} round(s), rolling back to "
+            f"the round-{round_idx} entry state and replaying (retry "
+            f"{self._round_retries}/{cfg.fed.robust.max_retries})"
+        )
+        self.adopt_state(self._recovery_state)
+        if self.server_opt is not None:
+            import copy
+
+            self.server_opt._state = copy.deepcopy(self._recovery_opt_state)
+
+    def _round_span_args(self) -> dict:
+        """Extra fed_round span attributes while recovery is active, so the
+        trace shows which rounds ran with clients excluded / as replays."""
+        args: dict = {}
+        if self._quarantine:
+            args["quarantined"] = sorted(self._quarantine)
+        if self._round_retries:
+            args["replay_retry"] = self._round_retries
+        return args
+
+    def _tick_quarantine(self) -> None:
+        """Advance the quarantine ledger by one completed round; expired
+        clients rejoin HEALED (params reset to the global, optimizer
+        moments zeroed) — their own state may still be NaN-poisoned, and
+        un-healed Adam moments would re-trigger the same quarantine the
+        moment it expires."""
+        if not self._quarantine:
+            return
+        expired = []
+        for c in list(self._quarantine):
+            self._quarantine[c] -= 1
+            if self._quarantine[c] <= 0:
+                expired.append(c)
+                del self._quarantine[c]
+        self._g_quarantined.set(float(len(self._quarantine)))
+        for c in expired:
+            self._heal_client(c)
+
+    def _heal_client(self, client: int) -> None:
+        cfg = self.cfg
+        donor = next(
+            (
+                c
+                for c in range(cfg.fed.num_clients)
+                if c != client and c not in self._quarantine
+            ),
+            None,
+        )
+        if donor is None:
+            return
+
+        def fix(tree, from_donor: bool):
+            def one(x):
+                x = np.array(x)
+                if x.ndim >= 1 and x.shape[0] == cfg.fed.num_clients:
+                    x[client] = x[donor] if from_donor else 0
+                return x
+
+            return jax.tree_util.tree_map(one, tree)
+
+        host = self._host_state()
+        self.adopt_state(
+            host.replace(
+                user_params=fix(host.user_params, True),
+                news_params=fix(host.news_params, True),
+                opt_user=fix(host.opt_user, False),
+                opt_news=fix(host.opt_news, False),
+                news_grad_accum=fix(host.news_grad_accum, False),
+            )
+        )
+        print(
+            f"[trainer] quarantine expired for client {client}: rejoined "
+            "with global params and fresh optimizer state"
+        )
 
     def _dump_flightrec(self, trigger: dict):
         if self.flightrec is None:
@@ -831,6 +1077,48 @@ class Trainer:
             hash((self.cfg.train.seed, round_idx)) & 0x7FFFFFFF
         )
 
+    def _round_weights(self, round_idx: int) -> np.ndarray:
+        """THE per-round aggregation weights: participation mask ×
+        chaos drop/straggle mask × quarantine exclusion — host-driven
+        rounds and rounds-in-jit chunks share this one composition.
+        Without chaos or quarantine it is exactly the participation mask
+        (value-identical to the pre-robust trajectory)."""
+        cfg = self.cfg
+        from fedrec_tpu.fed.strategies import participation_mask
+
+        w = np.asarray(
+            participation_mask(
+                self._mask_rng(round_idx), cfg.fed.num_clients,
+                cfg.fed.participation,
+            ),
+            np.float32,
+        )
+        if self.chaos is not None:
+            rf = self.chaos.round_faults(round_idx)
+            w = w * rf.weight_mask
+            for kind, count in (
+                ("drop", len(rf.dropped)), ("straggle", len(rf.straggled)),
+            ):
+                if count:
+                    self._m_chaos.inc(count, kind=kind)
+            for kind, _client in rf.injected:
+                self._m_chaos.inc(kind=kind)
+            if rf.straggled and cfg.chaos.straggle_ms > 0:
+                import time as _time
+
+                _time.sleep(cfg.chaos.straggle_ms / 1e3)
+        for c in self._quarantine:
+            if 0 <= c < w.shape[0]:
+                w[c] = 0.0
+        return w
+
+    def _chaos_batch_keys(self, round_idx: int) -> dict | None:
+        """Per-client fault vectors every chaos-enabled batch must carry
+        (``train.step`` applies them at the update boundary)."""
+        return (
+            self.chaos.batch_keys(round_idx) if self.chaos is not None else None
+        )
+
     def train_round(self, round_idx: int) -> RoundResult:
         """One host-driven federated round, wrapped in a ``fed_round`` host
         span AND a ``jax.profiler.StepTraceAnnotation`` carrying the same
@@ -839,8 +1127,10 @@ class Trainer:
         import time as _time
 
         t0 = _time.perf_counter()
-        with self.tracer.span("fed_round", step_num=round_idx, num_rounds=1), \
-                jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx):
+        with self.tracer.span(
+            "fed_round", step_num=round_idx, num_rounds=1,
+            **self._round_span_args(),
+        ), jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx):
             result = self._train_round_inner(round_idx)
             # HBM gauges at the round boundary, attributed (as an instant
             # event) to this fed_round span; no-op on allocator-less CPU
@@ -852,15 +1142,13 @@ class Trainer:
 
     def _train_round_inner(self, round_idx: int) -> RoundResult:
         cfg = self.cfg
-        from fedrec_tpu.fed.strategies import participation_mask
-
-        weights = participation_mask(
-            self._mask_rng(round_idx), cfg.fed.num_clients, cfg.fed.participation
-        )
+        weights_np = self._round_weights(round_idx)
+        weights = jnp.asarray(weights_np)
+        chaos_extra = self._chaos_batch_keys(round_idx)
         if self.flightrec is not None:
             self.flightrec.start_chunk(
                 round_idx, self._entry_state(),
-                {round_idx: np.asarray(weights)},
+                {round_idx: weights_np},
             )
 
         round_start_global = None
@@ -877,6 +1165,7 @@ class Trainer:
             )
 
         losses = []
+        raw_losses = []  # per-client loss cells: the NaN-robust fallback
         overflows = []  # device arrays; read once at round end (no per-step sync)
         # sentry aux vectors, same deal: appended as device arrays, one
         # host fetch at the round-end health check
@@ -887,6 +1176,7 @@ class Trainer:
 
         def keep_metrics(metrics) -> None:
             losses.append(metrics["mean_loss"])
+            raw_losses.append(metrics["loss"])
             if "unique_overflow" in metrics:
                 overflows.append(metrics["unique_overflow"])
             row = {k: v for k, v in metrics.items() if k.startswith("health.")}
@@ -921,7 +1211,7 @@ class Trainer:
             epoch_idx = round_idx * cfg.fed.local_epochs + local_epoch
             table = self._feature_table()
             group: list = []
-            it = self._epoch_batch_iter(epoch_idx)
+            it = self._epoch_batch_iter(epoch_idx, chaos_extra)
             src = iter(it)
             try:
                 while True:
@@ -962,8 +1252,11 @@ class Trainer:
                 )
 
         if self.strategy.sync_params_every_round:
-            with tracer.span("aggregate", round=round_idx):
+            with tracer.span(
+                "aggregate", round=round_idx, method=cfg.fed.robust.method
+            ):
                 self.state = self.param_sync(self.state, weights)
+            self._m_robust_rounds.inc(method=cfg.fed.robust.method)
             if self.server_opt is not None:
                 # FedOpt: the weighted mean is a proposal, not the new model —
                 # the server optimizer steps the global from round_start
@@ -990,8 +1283,9 @@ class Trainer:
         # flat mean over every (step, client) cell: scan chains contribute one
         # (scan_steps, clients) entry and per-batch steps one (clients,) entry,
         # so a mean-of-entry-means would overweight the epoch tail
-        train_loss = float(
-            np.mean(np.concatenate([np.asarray(l).reshape(-1) for l in losses]))
+        train_loss = self._round_loss_mean(
+            np.concatenate([np.asarray(l).reshape(-1) for l in losses]),
+            np.concatenate([np.asarray(l).reshape(-1) for l in raw_losses]),
         )
         # sentry digest FIRST: a non-finite sentinel is the root cause the
         # operator needs (and dumps the flight recorder) before any other
@@ -1012,6 +1306,22 @@ class Trainer:
         result = RoundResult(round_idx, train_loss)
         self._eval_if_due(result)
         return result
+
+    @staticmethod
+    def _round_loss_mean(mean_cells: np.ndarray, loss_cells: np.ndarray) -> float:
+        """The round's train loss. Healthy rounds: the flat mean over the
+        in-graph pmean cells — bit-identical to pre-robust reporting. When
+        any cell is non-finite (a chaos/quarantined client), the pmean is
+        NaN for EVERY client (the collective blends the poison), so the
+        metric falls back to the mean over the finite PER-CLIENT loss
+        cells: a NaN client's cells are the health sentry's signal
+        (counted there), not the cohort's progress metric."""
+        mean_cells = mean_cells.reshape(-1)
+        if np.isfinite(mean_cells).all():
+            return float(mean_cells.mean())
+        loss_cells = loss_cells.reshape(-1)
+        finite = loss_cells[np.isfinite(loss_cells)]
+        return float(finite.mean()) if finite.size else float("nan")
 
     def _overflow_message(self, total: int) -> str:
         cfg = self.cfg
@@ -1063,12 +1373,18 @@ class Trainer:
         """How many rounds starting at ``round_idx`` may run in one
         compiled chunk: up to ``train.rounds_per_scan``, never crossing a
         cadence boundary (so checkpoint/eval behavior is byte-identical to
-        the host-driven loop)."""
+        the host-driven loop) — nor a quarantine expiry: the chunk's
+        weights stack is built at entry, so a chunk outliving a quarantine
+        would exclude the client past its configured
+        ``fed.robust.quarantine_rounds`` and delay its healed rejoin."""
         if self.round_scan is None:
             return 1
+        cap = self.cfg.train.rounds_per_scan
+        if self._quarantine:
+            cap = min(cap, min(self._quarantine.values()))
         n = 1
         while (
-            n < self.cfg.train.rounds_per_scan
+            n < cap
             and round_idx + n < self.cfg.fed.rounds
             and not self._round_is_boundary(round_idx + n - 1)
         ):
@@ -1093,7 +1409,8 @@ class Trainer:
 
         t0 = _time.perf_counter()
         chunk_span = self.tracer.span(
-            "fed_round", step_num=round_idx, num_rounds=num_rounds
+            "fed_round", step_num=round_idx, num_rounds=num_rounds,
+            **self._round_span_args(),
         )
         chunk_annotation = jax.profiler.StepTraceAnnotation(
             "fed_round", step_num=round_idx
@@ -1115,16 +1432,8 @@ class Trainer:
     ) -> list[RoundResult]:
         cfg = self.cfg
         tracer = self.tracer
-        from fedrec_tpu.fed.strategies import participation_mask
-
         weights = np.stack([
-            np.asarray(
-                participation_mask(
-                    self._mask_rng(r),
-                    cfg.fed.num_clients,
-                    cfg.fed.participation,
-                )
-            )
+            self._round_weights(r)
             for r in range(round_idx, round_idx + num_rounds)
         ])
         table = self._feature_table()
@@ -1141,6 +1450,7 @@ class Trainer:
             steps: int | None = None
             for r in range(round_idx, round_idx + num_rounds):
                 batches: list[dict] = []
+                chaos_extra = self._chaos_batch_keys(r) or {}
                 for local_epoch in range(cfg.fed.local_epochs):
                     epoch_idx = r * cfg.fed.local_epochs + local_epoch
                     for b in self.batcher.epoch_batches_sharded(
@@ -1150,6 +1460,7 @@ class Trainer:
                             "candidates": b.candidates,
                             "history": b.history,
                             "labels": b.labels,
+                            **chaos_extra,
                         }
                         if self.flightrec is not None:
                             self.flightrec.record(
@@ -1182,14 +1493,19 @@ class Trainer:
             self.state, metrics = self.round_scan(
                 self.state, stacked, table, jnp.asarray(weights)
             )
+        if self.strategy.sync_params_every_round:
+            self._m_robust_rounds.inc(num_rounds, method=cfg.fed.robust.method)
 
         mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
+        raw_loss = np.asarray(metrics["loss"])
         results = []
         for i in range(num_rounds):
-            # flat mean over every (step, client) cell — same reduction as
-            # the host-driven round's loss bookkeeping
+            # same reduction as the host-driven round's loss bookkeeping
             results.append(
-                RoundResult(round_idx + i, float(mean_loss[i].mean()))
+                RoundResult(
+                    round_idx + i,
+                    self._round_loss_mean(mean_loss[i], raw_loss[i]),
+                )
             )
         # sentry digest first (see _train_round_inner): the health arrays
         # are already (rounds, steps, clients) in the chunk's metrics
@@ -1352,13 +1668,24 @@ class Trainer:
                     # cadence boundaries so the host-side bookkeeping below
                     # sees exactly the rounds it would host-driven
                     chunk = self._round_chunk(round_idx)
-                    if chunk > 1:
-                        results = self._train_rounds_scan(round_idx, chunk)
-                    else:
-                        results = [self.train_round(round_idx)]
+                    # rollback target: the state every client held at
+                    # round/chunk entry — one blocking host copy per round
+                    # is the price of replayability (same cost profile as
+                    # obs.health.snapshot_state); no-op unless recover
+                    self._capture_recovery_state()
+                    try:
+                        if chunk > 1:
+                            results = self._train_rounds_scan(round_idx, chunk)
+                        else:
+                            results = [self.train_round(round_idx)]
+                    except RoundRecovery as e:
+                        self._rollback_and_quarantine(e.trigger, round_idx)
+                        continue  # replay the same round/chunk
+                    self._round_retries = 0
                     for result in results:
                         history.append(result)
                         self._after_round(result)
+                        self._tick_quarantine()
                     round_idx += len(results)
             if self.snapshots is not None:
                 self.snapshots.wait()  # settle async saves before handing back

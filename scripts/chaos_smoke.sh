@@ -1,0 +1,118 @@
+#!/bin/bash
+# Chaos smoke (ISSUE-5 acceptance scenarios), CPU-only:
+#
+#   1. FAULT-FREE BASELINE: a 3-round trimmed-mean run; final loss banked.
+#   2. CHAOS RUN: the same config under a seeded FaultPlan — 30% dropout +
+#      one nan-update client + one x100 scale-poison client — with
+#      coordinate-wise trimmed mean (trim_k=2: two byzantine clients).
+#      Must complete all rounds with FINITE losses, and `fedrec-obs
+#      report` must render a Robustness section with the injected-fault
+#      counts.
+#   3. DETERMINISM: re-run the same plan; the per-round training_loss
+#      trajectory must be BIT-IDENTICAL.
+#   4. RECOVERY: an injected nan-update with fed.robust.recover=true —
+#      quarantine + rollback + a completed run (no flight-recorder
+#      abort), rollback visible in the registry counters.
+#
+#   scripts/chaos_smoke.sh     # or: make chaos-smoke
+#
+# Artifacts land under /tmp/fedrec_chaos_smoke for inspection.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${CHAOS_SMOKE_DIR:-/tmp/fedrec_chaos_smoke}
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+run() {
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
+}
+
+SMALL=(
+    --set model.news_dim=32 --set model.num_heads=4 --set model.head_dim=8
+    --set model.query_dim=16 --set model.bert_hidden=48
+    --set data.max_his_len=10 --set data.max_title_len=12
+    --set train.eval_every=1000 --set train.eval_protocol=sampled
+    --set fed.robust.method=trimmed_mean
+)
+CHAOS=(
+    --set chaos.enabled=true --set chaos.seed=7 --set chaos.drop_rate=0.3
+    --set "chaos.faults=nan@*:3,scale@*:5x100"
+    --set fed.robust.trim_k=2
+    --set obs.health.abort_on_nonfinite=false
+)
+
+echo "== [1/4] fault-free trimmed-mean baseline =="
+run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
+    --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
+    --obs-dir "$OUT/baseline" "${SMALL[@]}" \
+    --set train.snapshot_dir="$OUT/base_snap" \
+    > "$OUT/baseline.log" 2>&1 || { tail -30 "$OUT/baseline.log"; exit 1; }
+
+echo "== [2/4] chaos run: 30% dropout + nan client + x100 poison client =="
+run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
+    --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
+    --obs-dir "$OUT/chaos_a" "${SMALL[@]}" "${CHAOS[@]}" \
+    --set train.snapshot_dir="$OUT/chaos_a_snap" \
+    > "$OUT/chaos_a.log" 2>&1 || { tail -30 "$OUT/chaos_a.log"; exit 1; }
+
+echo "== [3/4] determinism: same plan, bit-identical trajectory =="
+run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
+    --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
+    --obs-dir "$OUT/chaos_b" "${SMALL[@]}" "${CHAOS[@]}" \
+    --set train.snapshot_dir="$OUT/chaos_b_snap" \
+    > "$OUT/chaos_b.log" 2>&1 || { tail -30 "$OUT/chaos_b.log"; exit 1; }
+
+echo "== [4/4] recovery: nan client + fed.robust.recover=true =="
+run python -m fedrec_tpu.cli.run 4 8 10 --strategy param_avg --clients 8 \
+    --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
+    --obs-dir "$OUT/recover" "${SMALL[@]}" \
+    --set chaos.enabled=true --set "chaos.faults=nan@1:3" \
+    --set fed.robust.recover=true \
+    --set train.snapshot_dir="$OUT/recover_snap" \
+    > "$OUT/recover.log" 2>&1 || { tail -30 "$OUT/recover.log"; exit 1; }
+
+run python - "$OUT" <<'EOF'
+import json, math, sys
+from pathlib import Path
+
+out = Path(sys.argv[1])
+
+def losses(d):
+    rows = {}
+    for line in (out / d / "metrics.jsonl").read_text().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(r, dict) and "training_loss" in r and "round" in r:
+            rows[int(r["round"])] = r["training_loss"]
+    return [rows[k] for k in sorted(rows)]
+
+base, a, b = losses("baseline"), losses("chaos_a"), losses("chaos_b")
+assert len(a) == 3 and all(map(math.isfinite, a)), f"chaos run not finite: {a}"
+assert a == b, f"chaos trajectory not bit-identical:\n{a}\n{b}"
+assert all(map(math.isfinite, base))
+# robust run's loss within shouting distance of the fault-free baseline
+assert abs(a[-1] - base[-1]) < 0.25, (a[-1], base[-1])
+
+from fedrec_tpu.obs.report import build_report, load_jsonl
+records, snaps = load_jsonl(out / "chaos_a" / "metrics.jsonl")
+rb = build_report(records, snaps).get("robustness")
+assert rb and rb.get("robust_method") == "trimmed_mean", rb
+fi = rb.get("faults_injected", {})
+assert fi.get("nan", 0) >= 3 and fi.get("scale", 0) >= 3 and fi.get("drop", 0) >= 1, fi
+
+rec_records, rec_snaps = load_jsonl(out / "recover" / "metrics.jsonl")
+rrb = build_report(rec_records, rec_snaps)["robustness"]
+assert rrb.get("rollbacks", 0) >= 1 and rrb.get("quarantines", 0) >= 1, rrb
+rec = losses("recover")
+assert len(rec) == 4 and all(map(math.isfinite, rec)), rec
+print("chaos smoke OK")
+print(f"  baseline   losses: {base}")
+print(f"  chaos      losses: {a}  (bit-identical on re-run)")
+print(f"  recovery   losses: {rec}  rollbacks={rrb['rollbacks']:.0f} quarantines={rrb['quarantines']:.0f}")
+EOF
+
+echo "chaos smoke PASSED; artifacts in $OUT"

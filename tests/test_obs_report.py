@@ -180,3 +180,47 @@ def test_cli_report_reads_rotated_event_log(tmp_path, capsys):
     # first/last prove the rotated file was read FIRST
     assert report["training"]["first_loss"] == 2.0
     assert report["training"]["last_loss"] == 1.0
+
+
+def test_report_robustness_section(tmp_path):
+    """The Robustness section (ISSUE 5): chaos fault counts, quarantines,
+    rollbacks, and the robust-aggregation method in use, rendered from the
+    registry counters the Trainer publishes."""
+    from fedrec_tpu.obs.report import render_text
+
+    reg = MetricsRegistry()
+    faults = reg.counter("chaos.faults_total", labels=("kind",))
+    faults.inc(5, kind="drop")
+    faults.inc(3, kind="nan")
+    reg.counter("fed.quarantines_total").inc(2)
+    reg.counter("fed.rollbacks_total").inc(2)
+    reg.gauge("fed.quarantine_active").set(1)
+    reg.counter("fed.robust_rounds_total", labels=("method",)).inc(
+        6, method="trimmed_mean"
+    )
+    jsonl = tmp_path / "metrics.jsonl"
+    reg.write_snapshot(jsonl)
+    records, snapshots = load_jsonl(jsonl)
+    report = build_report(records, snapshots)
+    rb = report["robustness"]
+    assert rb["faults_injected"] == {"drop": 5.0, "nan": 3.0}
+    assert rb["quarantines"] == 2.0
+    assert rb["rollbacks"] == 2.0
+    assert rb["quarantine_active"] == 1.0
+    assert rb["robust_method"] == "trimmed_mean"
+    assert rb["robust_rounds"] == 6.0
+    text = render_text(report)
+    assert "## Robustness" in text
+    assert "trimmed_mean" in text
+    assert "drop=5" in text and "nan=3" in text
+    assert "quarantined: 2" in text
+
+
+def test_report_has_no_robustness_section_when_counters_zero(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("fed.quarantines_total")  # registered, zero-valued
+    reg.gauge("fed.quarantine_active").set(0)
+    jsonl = tmp_path / "metrics.jsonl"
+    reg.write_snapshot(jsonl)
+    _, snapshots = load_jsonl(jsonl)
+    assert "robustness" not in build_report([], snapshots)

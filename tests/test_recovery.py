@@ -1,0 +1,177 @@
+"""Quarantine-and-rollback recovery (``fed.robust.recover``).
+
+Acceptance (ISSUE 5): an injected nan-update with recover=true produces
+quarantine + rollback + a completed run (no flight-recorder abort), with
+the rollback visible in the metrics registry and trace; with
+recover=false the PR-4 abort-and-dump behavior is unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.data import make_synthetic_mind
+from fedrec_tpu.obs import (
+    MetricsRegistry,
+    Tracer,
+    TrainingHealthError,
+    set_registry,
+    set_tracer,
+)
+
+
+def _trainer(recover: bool, rounds: int = 5, faults: str = "nan@1:3",
+             quarantine_rounds: int = 3, obs_dir: str | None = None,
+             outlier_recovery: bool = False):
+    from fedrec_tpu.train.trainer import Trainer
+
+    set_registry(MetricsRegistry())
+    set_tracer(Tracer())
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.model.text_encoder_mode = "head"
+    cfg.data.max_his_len = 10
+    cfg.data.max_title_len = 12
+    cfg.data.batch_size = 8
+    cfg.fed.num_clients = 8
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = rounds
+    cfg.train.snapshot_dir = ""
+    cfg.train.eval_every = 1000
+    cfg.chaos.enabled = True
+    cfg.chaos.faults = faults
+    cfg.fed.robust.recover = recover
+    cfg.fed.robust.quarantine_rounds = quarantine_rounds
+    if outlier_recovery:
+        cfg.obs.health.outlier_k = 3.0
+    if obs_dir is not None:
+        cfg.obs.dir = obs_dir
+    data = make_synthetic_mind(
+        num_news=64, num_train=256, num_valid=64,
+        title_len=12, his_len_range=(2, 10), seed=0, popular_frac=0.2,
+    )
+    states = np.random.default_rng(1).standard_normal(
+        (64, 12, 48)
+    ).astype(np.float32)
+    return Trainer(cfg, data, states)
+
+
+def _rollback_events(tracer):
+    return [e for e in tracer._events if e.get("name") == "rollback"]
+
+
+def test_recover_false_keeps_pr4_abort(tmp_path):
+    t = _trainer(recover=False, obs_dir=str(tmp_path / "obs"))
+    with pytest.raises(TrainingHealthError, match="nonfinite"):
+        t.run()
+    # the flight recorder dumped forensics like before
+    assert (tmp_path / "obs" / "flightrec" / "manifest.json").exists()
+
+
+def test_recover_true_quarantines_rolls_back_and_completes():
+    t = _trainer(recover=True)
+    history = t.run()  # must NOT raise
+    assert len(history) == 5
+    losses = [r.train_loss for r in history]
+    assert all(np.isfinite(losses)), losses
+
+    reg = t.registry
+    assert reg.counter("fed.rollbacks_total").value() >= 1
+    assert reg.counter("fed.quarantines_total").value() >= 1
+    # quarantine expired before the run ended (1 fault, 3-round sentence)
+    assert reg.gauge("fed.quarantine_active").value() == 0.0
+
+    # the rollback is stamped into the trace, and the replayed round's
+    # fed_round span carries the quarantine set
+    rb = _rollback_events(t.tracer)
+    assert rb and rb[0]["args"]["client"] == 3
+    fed_rounds = [
+        e for e in t.tracer._events
+        if e.get("name") == "fed_round" and "quarantined" in e.get("args", {})
+    ]
+    assert fed_rounds and 3 in fed_rounds[0]["args"]["quarantined"]
+
+    # all clients hold the (finite) aggregate at the end — the healed
+    # client rejoined rather than staying NaN
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(t.state.user_params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_recover_retry_budget_bounds_rollbacks():
+    """Two byzantine clients, max_retries=1: the second trigger in the
+    same round exhausts the budget and the existing abort fires."""
+    t = _trainer(recover=True, faults="nan@1:3,nan@1:5")
+    t.cfg.fed.robust.max_retries = 1
+    with pytest.raises(TrainingHealthError):
+        t.run()
+    assert t.registry.counter("fed.rollbacks_total").value() == 1
+
+
+@pytest.mark.slow  # jit-heavy; tier-1 keeps the fast unit proofs
+def test_recover_two_bad_clients_with_budget():
+    t = _trainer(recover=True, faults="nan@1:3,nan@1:5", rounds=5)
+    assert t.cfg.fed.robust.max_retries == 2
+    history = t.run()
+    assert len(history) == 5
+    assert all(np.isfinite(r.train_loss) for r in history)
+    assert t.registry.counter("fed.quarantines_total").value() == 2
+
+
+@pytest.mark.slow  # jit-heavy; tier-1 keeps the fast unit proofs
+def test_recover_from_outlier_scale_poison():
+    """An outlier (×1000-scaled, still finite) client trips the
+    update-norm > k·median flag and is quarantined the same way."""
+    t = _trainer(
+        recover=True, faults="scale@1:2x1000", outlier_recovery=True,
+        rounds=4,
+    )
+    history = t.run()
+    assert len(history) == 4
+    assert all(np.isfinite(r.train_loss) for r in history)
+    reg = t.registry
+    assert reg.counter("fed.rollbacks_total").value() >= 1
+    rb = _rollback_events(t.tracer)
+    assert rb and rb[0]["args"]["kind"] == "outlier"
+    assert rb[0]["args"]["client"] == 2
+
+
+def test_recover_validation():
+    from fedrec_tpu.train.trainer import Trainer
+
+    set_registry(MetricsRegistry())
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.data.max_his_len = 10
+    cfg.data.max_title_len = 12
+    cfg.fed.num_clients = 8
+    cfg.train.snapshot_dir = ""
+    data = make_synthetic_mind(
+        num_news=32, num_train=64, num_valid=0, title_len=12,
+        his_len_range=(2, 10), seed=0,
+    )
+    states = np.zeros((32, 12, 48), np.float32)
+
+    cfg.fed.strategy = "grad_avg"
+    cfg.fed.robust.method = "median"
+    with pytest.raises(ValueError, match="robust.method"):
+        Trainer(cfg, data, states)
+    cfg.fed.robust.method = "mean"
+    cfg.fed.robust.recover = True
+    with pytest.raises(ValueError, match="recover"):
+        Trainer(cfg, data, states)
+    cfg.fed.strategy = "param_avg"
+    cfg.obs.health.sentry = False
+    with pytest.raises(ValueError, match="sentry"):
+        Trainer(cfg, data, states)

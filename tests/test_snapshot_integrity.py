@@ -1,0 +1,175 @@
+"""Checkpoint integrity: a corrupt/torn newest snapshot must not kill resume.
+
+``SnapshotManager.restore`` verifies the loaded pytree (finite-ness of a
+sampled subset of every parameter leaf) and falls back to the previous
+retained snapshot when the latest is corrupt — the resumed run continues
+from round r - save_every instead of crashing (ISSUE 5 satellite).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.data import make_synthetic_mind
+from fedrec_tpu.obs import MetricsRegistry, Tracer, set_registry, set_tracer
+from fedrec_tpu.train.checkpoint import (
+    SnapshotIntegrityError,
+    SnapshotManager,
+    verify_state_tree,
+)
+
+
+def _cfg(tmp_path, rounds=3):
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.model.text_encoder_mode = "head"
+    cfg.data.max_his_len = 10
+    cfg.data.max_title_len = 12
+    cfg.data.batch_size = 8
+    cfg.fed.num_clients = 4
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.rounds = rounds
+    cfg.train.save_every = 1
+    cfg.train.snapshot_dir = str(tmp_path / "snaps")
+    cfg.train.eval_every = 1000
+    return cfg
+
+
+def _trainer(cfg):
+    from fedrec_tpu.train.trainer import Trainer
+
+    set_registry(MetricsRegistry())
+    set_tracer(Tracer())
+    data = make_synthetic_mind(
+        num_news=64, num_train=128, num_valid=32,
+        title_len=12, his_len_range=(2, 10), seed=0, popular_frac=0.2,
+    )
+    states = np.random.default_rng(1).standard_normal(
+        (64, 12, 48)
+    ).astype(np.float32)
+    return Trainer(cfg, data, states)
+
+
+def _step_dirs(snap_dir):
+    return sorted(
+        (p for p in snap_dir.iterdir() if p.is_dir() and p.name.isdigit()),
+        key=lambda p: int(p.name),
+    )
+
+
+def _corrupt(step_dir):
+    """Truncate every data file in a snapshot step dir — the torn-write /
+    bad-disk simulation."""
+    n = 0
+    for f in step_dir.rglob("*"):
+        if f.is_file() and f.stat().st_size > 0:
+            f.write_bytes(f.read_bytes()[: max(f.stat().st_size // 2, 1)])
+            n += 1
+    assert n > 0, f"nothing to corrupt under {step_dir}"
+
+
+@pytest.mark.slow  # jit-heavy; tier-1 keeps the fast unit proofs
+def test_truncated_latest_snapshot_falls_back_one_save(tmp_path):
+    cfg = _cfg(tmp_path, rounds=3)
+    t = _trainer(cfg)
+    t.run()  # snapshots at rounds 0, 1, 2
+    snap_dir = t.snapshots.directory
+    steps = _step_dirs(snap_dir)
+    assert [int(p.name) for p in steps] == [0, 1, 2]
+    _corrupt(steps[-1])
+
+    cfg2 = _cfg(tmp_path, rounds=4)
+    t2 = _trainer(cfg2)  # resume path runs in __init__
+    # resumed from round r - save_every = 1, NOT a crash, NOT round 2
+    assert t2.start_round == 2
+    history = t2.run()
+    assert [r.round_idx for r in history] == [2, 3]
+    assert all(np.isfinite(r.train_loss) for r in history)
+
+
+@pytest.mark.slow  # jit-heavy; tier-1 keeps the fast unit proofs
+def test_all_snapshots_corrupt_raises_actionable_error(tmp_path):
+    cfg = _cfg(tmp_path, rounds=2)
+    t = _trainer(cfg)
+    t.run()
+    for d in _step_dirs(t.snapshots.directory):
+        _corrupt(d)
+    cfg2 = _cfg(tmp_path, rounds=3)
+    with pytest.raises(RuntimeError, match="snapshot"):
+        _trainer(cfg2)
+
+
+def test_verify_state_tree_catches_nonfinite_params():
+    class S:
+        user_params = {"w": np.ones((4, 3), np.float32)}
+        news_params = {"w": np.ones((4, 3), np.float32)}
+
+    verify_state_tree(S())  # finite: fine
+    S.news_params = {"w": np.full((4, 3), np.nan, np.float32)}
+    with pytest.raises(SnapshotIntegrityError, match="news_params"):
+        verify_state_tree(S())
+
+
+def test_verify_ignores_nonfinite_optimizer_moments():
+    """A quarantine-era snapshot may carry NaN Adam moments for an
+    excluded client — params-only verification must accept it."""
+
+    class S:
+        user_params = {"w": np.ones((4, 3), np.float32)}
+        news_params = {"w": np.ones((4, 3), np.float32)}
+        opt_user = {"mu": np.full((4, 3), np.nan, np.float32)}
+
+    verify_state_tree(S())  # must not raise
+
+
+@pytest.mark.slow  # jit-heavy; tier-1 keeps the fast unit proofs
+def test_restore_with_explicit_round_does_not_fall_back(tmp_path):
+    cfg = _cfg(tmp_path, rounds=3)
+    t = _trainer(cfg)
+    t.run()
+    snaps = SnapshotManager(t.snapshots.directory)
+    template = t.state
+    _corrupt(_step_dirs(t.snapshots.directory)[-1])
+    with pytest.raises(Exception):
+        snaps.restore(template, round_idx=2)
+    # the untouched round-1 snapshot restores explicitly
+    out = snaps.restore(template, round_idx=1)
+    assert snaps.last_restored_round == 1
+    assert out is not None
+
+
+def test_coordinator_corrupt_local_snapshot_starts_fresh(tmp_path, capsys):
+    """The coordinator's msgpack resume path: a torn per-process local
+    snapshot (crash mid-write, or the chaos.torn_snapshot_round fault)
+    must degrade to a fresh start of this shard, not a crashed resume —
+    the server fan-out re-integrates it like a brand-new elastic host."""
+    from fedrec_tpu.cli.coordinator import main
+
+    snap = tmp_path / "local_state_p0.msgpack"
+    snap.write_bytes(b"\x81\xa5state\xc4\x04junk")  # torn msgpack blob
+    rc = main([
+        "2", "8", "1",
+        "--synthetic", "--synthetic-train", "64", "--synthetic-news", "32",
+        "--clients", "2",
+        "--resume-local-state", str(snap),
+        "--set", "model.bert_hidden=48", "--set", "data.max_his_len=10",
+        "--set", "data.max_title_len=12", "--set", "model.news_dim=32",
+        "--set", "model.num_heads=4", "--set", "model.head_dim=8",
+        "--set", "model.query_dim=16",
+        "--set", f"train.snapshot_dir={tmp_path / 'snaps'}",
+        "--set", "train.eval_every=1000",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "corrupt/torn" in out
+    # the run completed and wrote a GOOD snapshot over the torn one
+    from flax import serialization
+
+    restored = serialization.msgpack_restore(snap.read_bytes())
+    assert int(restored["round"]) == 1  # 2 rounds, save_every=1
