@@ -237,6 +237,18 @@ def main(argv: list[str] | None = None) -> int:
         collective_timeout_s=args.collective_timeout or None,
         compress=cfg.fed.dcn_compress,
         robust=cfg.fed.robust,
+        # cross-device round deadline: bound the round-end report gather
+        # (fed.population.round_deadline_ms) so a straggling peer costs a
+        # bounded wait, never a wedged run. NOTE this is a REAL wall-clock
+        # bound on the DCN all-gather (a miss degrades this host to
+        # standalone for the remaining rounds — collectives are ordered
+        # and a partial gather cannot be resumed), so on a coordinator
+        # deployment size it to real gather time, not to the simulated
+        # straggle scale the in-process deadline cuts against
+        round_deadline_s=(
+            cfg.fed.population.round_deadline_ms / 1e3
+            if cfg.fed.population.round_deadline_ms > 0 else None
+        ),
     )
     apply_process_sharding(cfg, rt, args.server_trains)
 

@@ -196,6 +196,47 @@ class RobustConfig:
 
 
 @dataclass
+class PopulationConfig:
+    """Cross-device cohort engine (``fedrec_tpu.fed.population``).
+
+    Separates *logical clients* (``num_clients`` of them, per-client state
+    kept host-side) from the physical device slots (``fed.num_clients``,
+    the mesh's cohort layout): each round a seeded
+    :class:`~fedrec_tpu.fed.sampling.CohortSampler` draws
+    ``ceil(slots * over_select)`` logical clients, the survivors of the
+    (chaos-simulated) dropout are packed into the slots, and clients whose
+    simulated report latency exceeds ``round_deadline_ms`` are cut with
+    participation weight 0.  Below ``min_reports`` reporting clients the
+    round is discarded and replayed with a fresh draw (the quorum policy);
+    ``quorum_retries`` bounds the re-draws before the run aborts.
+
+    ``num_clients == fed.num_clients`` is the degenerate (cross-silo)
+    configuration: every client is selected every round, the data path and
+    trajectory are bit-identical to a run without a population section
+    (pinned in ``tests/test_population.py``).  ``num_clients`` above the
+    slot count turns on real per-round sampling: each logical client then
+    OWNS a static, seeded, equal-size shard of the corpus (non-IID-ready),
+    and its optimizer sidecar persists across selections
+    (``client_state="persist"``) or resets to the template each time
+    (``"reset"`` — stateless cross-device semantics).
+    """
+
+    num_clients: int = 0               # 0 = off; == slots = degenerate; > slots = sampled
+    sampler: str = "uniform"           # "uniform" | "weighted" | "skew"
+    seed: int = 0                      # cohort-draw seed (schedule identity)
+    over_select: float = 1.0           # sample ceil(slots * over_select) candidates
+    round_deadline_ms: float = 0.0     # report-latency cut; 0 = no deadline
+    min_reports: int = 0               # quorum: fewer reporters discards the round
+    quorum_retries: int = 3            # re-draws per round before aborting
+    client_state: str = "persist"      # "persist" sidecars across selections | "reset"
+    # sidecar residency: how many clients' optimizer sidecars stay in host
+    # RAM; above the cap the least-recently-selected spill to disk
+    # (``spill_dir``, default <snapshot_dir>/popspill). 0 = unbounded.
+    resident_cap: int = 0
+    spill_dir: str = ""
+
+
+@dataclass
 class FedConfig:
     """Federation strategy (reference modes a-d, SURVEY.md section 0)."""
 
@@ -236,6 +277,9 @@ class FedConfig:
     # round-end sync (param_avg, host-driven AND rounds-in-jit) and the
     # coordinator's cross-host gather.
     robust: RobustConfig = field(default_factory=RobustConfig)
+    # cross-device cohort engine: logical-client population sampled onto
+    # the device slots each round (see PopulationConfig).
+    population: PopulationConfig = field(default_factory=PopulationConfig)
 
 
 @dataclass
@@ -356,6 +400,21 @@ class ChaosConfig:
     straggle_rate: float = 0.0         # ditto; weight 0 + optional host delay
     straggle_ms: float = 0.0           # host-driven path: sleep per straggler round
     faults: str = ""                   # "kind@round:client[xscale]" comma list
+    # ---- population-level fault distributions (fed.population): applied
+    # to LOGICAL client ids at cohort-sampling time, seeded per
+    # (seed, round, attempt, client) so a whole sampled-cohort run replays
+    # bit-identically. pop_drop_rate is each sampled client's per-round
+    # Bernoulli dropout probability; a seeded pop_flaky_fraction subset of
+    # the population drops at pop_flaky_drop_rate instead (chronically bad
+    # radios). pop_straggle_ms > 0 draws each reporting client's simulated
+    # report latency from lognormal(median=pop_straggle_ms,
+    # sigma=pop_straggle_sigma); clients past fed.population's
+    # round_deadline_ms are deadline-cut (weight 0).
+    pop_drop_rate: float = 0.0
+    pop_flaky_fraction: float = 0.0
+    pop_flaky_drop_rate: float = 0.5
+    pop_straggle_ms: float = 0.0
+    pop_straggle_sigma: float = 1.0
     # host faults (coordinator deployment only):
     kill_round: int = -1               # process exits hard at this round's entry
     kill_process: int = -1             #   which coordinator process dies

@@ -14,23 +14,52 @@ from fedrec_tpu.fed.robust import (
     robust_reduce_tree_np,
     validate_robust_method,
 )
-from fedrec_tpu.fed.chaos import FAULT_CODES, FaultPlan, RoundFaults, parse_faults
+from fedrec_tpu.fed.chaos import (
+    FAULT_CODES,
+    FaultPlan,
+    RoundFaults,
+    parse_faults,
+    population_report,
+)
+from fedrec_tpu.fed.population import (
+    ClientPopulation,
+    CohortPlan,
+    ParticipationLedger,
+    QuorumFailure,
+    build_cohort_plan,
+    plan_round_weights,
+)
+from fedrec_tpu.fed.sampling import (
+    SAMPLER_MODES,
+    CohortSampler,
+    validate_sampler_mode,
+)
 
 __all__ = [
     "FAULT_CODES",
+    "ClientPopulation",
+    "CohortPlan",
+    "CohortSampler",
     "FaultPlan",
     "FedStrategy",
     "GradAvg",
     "Local",
     "ParamAvg",
+    "ParticipationLedger",
+    "QuorumFailure",
     "ROBUST_METHODS",
     "RoundFaults",
+    "SAMPLER_MODES",
+    "build_cohort_plan",
     "get_strategy",
     "parse_faults",
     "participation_mask",
+    "plan_round_weights",
+    "population_report",
     "robust_aggregate",
     "robust_reduce_np",
     "robust_reduce_tree_np",
     "validate_robust_method",
+    "validate_sampler_mode",
     "weighted_param_avg",
 ]

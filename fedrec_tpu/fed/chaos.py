@@ -156,3 +156,64 @@ class FaultPlan:
         boundary)."""
         rf = self.round_faults(round_idx)
         return {"chaos.code": rf.codes, "chaos.scale": rf.scales}
+
+    # ---------------------------------------------- population-level faults
+    def is_flaky(self, client_id: int) -> bool:
+        """Whether a LOGICAL client belongs to the seeded flaky cohort —
+        a fixed ``pop_flaky_fraction`` subset of the population whose
+        per-round dropout probability is ``pop_flaky_drop_rate`` instead
+        of ``pop_drop_rate`` (chronically bad connectivity, not bad
+        luck). Pure in ``(seed, client_id)``: flakiness is a property of
+        the client, stable across rounds and replays."""
+        frac = float(getattr(self.cfg, "pop_flaky_fraction", 0.0))
+        if frac <= 0:
+            return False
+        u = np.random.default_rng([self.seed, int(client_id), 0xF1A]).random()
+        return bool(u < frac)
+
+    def population_report(
+        self, round_idx: int, client_ids, attempt: int = 0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Simulate one round's reporting behavior for sampled LOGICAL
+        clients: ``(dropped, latency_ms)`` — ``dropped[i]`` True when
+        client ``client_ids[i]`` never starts (over-selection's target),
+        ``latency_ms[i]`` its simulated report latency (the round
+        deadline's target; 0 when ``pop_straggle_ms`` is off).
+
+        Deterministic per ``(seed, round_idx, attempt, client_id)``: the
+        same client gets the same fate in both the cohort-packing draw and
+        the per-round weight computation, replays are bit-identical, and a
+        quorum re-draw (``attempt`` bump) rolls genuinely fresh dice.
+        """
+        return population_report(self, round_idx, client_ids, attempt)
+
+
+def population_report(
+    plan: "FaultPlan | None", round_idx: int, client_ids, attempt: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Module-level variant tolerating ``plan=None`` (chaos disabled):
+    nobody drops, everybody reports instantly."""
+    ids = np.asarray(client_ids, np.int64)
+    dropped = np.zeros(ids.shape, bool)
+    latency = np.zeros(ids.shape, np.float64)
+    if plan is None:
+        return dropped, latency
+    cfg = plan.cfg
+    drop_rate = float(getattr(cfg, "pop_drop_rate", 0.0))
+    flaky_rate = float(getattr(cfg, "pop_flaky_drop_rate", 0.5))
+    straggle_ms = float(getattr(cfg, "pop_straggle_ms", 0.0))
+    straggle_sigma = float(getattr(cfg, "pop_straggle_sigma", 1.0))
+    any_flaky = float(getattr(cfg, "pop_flaky_fraction", 0.0)) > 0
+    if drop_rate <= 0 and not any_flaky and straggle_ms <= 0:
+        return dropped, latency
+    for i, cid in enumerate(ids):
+        rng = np.random.default_rng(
+            [plan.seed, int(round_idx), int(attempt), int(cid), 0x90B]
+        )
+        p = flaky_rate if (any_flaky and plan.is_flaky(int(cid))) else drop_rate
+        dropped[i] = rng.random() < p
+        if straggle_ms > 0:
+            # lognormal with median = pop_straggle_ms: half the population
+            # reports faster, the heavy tail is what deadlines cut
+            latency[i] = straggle_ms * rng.lognormal(0.0, straggle_sigma)
+    return dropped, latency

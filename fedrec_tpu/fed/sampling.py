@@ -1,0 +1,177 @@
+"""Seeded, checkpointable per-round cohort sampling over a logical-client
+population.
+
+Cross-device federation (FedJAX, arxiv 2108.02117; "Scaling Federated
+Learning for Fine-tuning of Large Language Models") trains a population of
+N >> devices logical clients by sampling a cohort per round. The sampler
+here is the ONE source of the cohort schedule:
+
+* **deterministic** — a draw is a pure function of ``(seed, round_idx,
+  attempt)`` plus the sampler's fairness state, so two runs with the same
+  seed produce the identical schedule, and a quorum re-draw (``attempt``
+  bumps) is itself reproducible;
+* **checkpointable** — :meth:`CohortSampler.state_dict` /
+  :meth:`load_state_dict` round-trip the mutable state (the skew mode's
+  selection counts, the committed-round counter), so a restored run
+  resumes the *identical* cohort schedule (pinned in
+  ``tests/test_population.py``);
+* **priority-ordered** — the returned ids are in descending draw priority:
+  the cohort packer fills device slots front-to-back, so over-selected
+  spares are exactly the tail of the draw.
+
+Modes (``fed.population.sampler``):
+
+* ``uniform``  — every eligible client equally likely (Gumbel-top-k over
+  zero log-weights == a uniform sample without replacement);
+* ``weighted`` — probability proportional to the client's sample count
+  (classic cross-device selection bias toward data-rich clients);
+* ``skew``     — non-IID-skew-aware coverage sampling: log-weight
+  ``-log1p(times_selected)``, so rarely-seen clients are favored and the
+  population's selection histogram flattens over rounds — the antidote to
+  uniform sampling starving the tail under heavy-tailed availability.
+
+The degenerate contract: when ``k`` covers the whole eligible population
+the draw returns the eligible ids in ASCENDING ID ORDER (not priority
+order), so a population == slots configuration packs client *i* into slot
+*i* every round and the trainer's load/unload machinery is a no-op — the
+bit-identical cross-silo limit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SAMPLER_MODES = ("uniform", "weighted", "skew")
+
+
+def validate_sampler_mode(mode: str) -> str:
+    if mode not in SAMPLER_MODES:
+        raise ValueError(
+            f"unknown fed.population.sampler {mode!r}; expected one of "
+            f"{SAMPLER_MODES}"
+        )
+    return mode
+
+
+class CohortSampler:
+    """Per-round cohort draws over ``population`` logical clients."""
+
+    def __init__(
+        self,
+        population: int,
+        mode: str = "uniform",
+        seed: int = 0,
+        sample_counts: np.ndarray | None = None,
+        skew_strength: float = 1.0,
+    ):
+        if population <= 0:
+            raise ValueError(f"population must be positive, got {population}")
+        validate_sampler_mode(mode)
+        self.population = int(population)
+        self.mode = mode
+        self.seed = int(seed)
+        self.skew_strength = float(skew_strength)
+        if sample_counts is None:
+            sample_counts = np.ones((self.population,), np.int64)
+        sample_counts = np.asarray(sample_counts, np.int64)
+        if sample_counts.shape != (self.population,):
+            raise ValueError(
+                f"sample_counts shape {sample_counts.shape} != "
+                f"({self.population},)"
+            )
+        if mode == "weighted" and not (sample_counts > 0).any():
+            raise ValueError("weighted sampling needs >= 1 positive count")
+        self.sample_counts = sample_counts
+        # mutable fairness state — the checkpointed part
+        self.selection_counts = np.zeros((self.population,), np.int64)
+        self.rounds_committed = 0
+
+    # ---------------------------------------------------------------- draw
+    def _log_weights(self) -> np.ndarray:
+        if self.mode == "uniform":
+            return np.zeros((self.population,), np.float64)
+        if self.mode == "weighted":
+            return np.log(np.maximum(self.sample_counts, 1).astype(np.float64))
+        # skew: favor clients the schedule has seen least
+        return -self.skew_strength * np.log1p(
+            self.selection_counts.astype(np.float64)
+        )
+
+    def draw(
+        self,
+        round_idx: int,
+        k: int,
+        exclude: set | frozenset | tuple = (),
+        attempt: int = 0,
+    ) -> np.ndarray:
+        """``min(k, eligible)`` distinct client ids for one round.
+
+        Pure in ``(seed, round_idx, attempt)`` and the current fairness
+        state; does NOT mutate state — call :meth:`record` once the round
+        the cohort trained actually commits (so a rolled-back round does
+        not skew the coverage counts).
+        """
+        eligible = np.ones((self.population,), bool)
+        for c in exclude:
+            if 0 <= int(c) < self.population:
+                eligible[int(c)] = False
+        n_eligible = int(eligible.sum())
+        if n_eligible == 0:
+            return np.zeros((0,), np.int64)
+        ids = np.nonzero(eligible)[0]
+        if k >= n_eligible:
+            # degenerate contract: full coverage keeps ascending id order,
+            # so population == slots packs identity and swaps nothing
+            return ids.astype(np.int64)
+        rng = np.random.default_rng(
+            [self.seed, int(round_idx), int(attempt), 0xC0407]
+        )
+        # Gumbel-top-k == sampling without replacement proportional to the
+        # (exp of the) log-weights; one vectorized draw, no rejection loop
+        keys = self._log_weights() + rng.gumbel(size=self.population)
+        keys[~eligible] = -np.inf
+        order = np.argsort(-keys, kind="stable")
+        return order[:k].astype(np.int64)
+
+    def record(self, cohort: np.ndarray) -> None:
+        """Commit one round's cohort into the fairness state."""
+        cohort = np.asarray(cohort, np.int64)
+        if cohort.size:
+            np.add.at(self.selection_counts, cohort, 1)
+        self.rounds_committed += 1
+
+    # --------------------------------------------------------- persistence
+    def state_dict(self) -> dict:
+        return {
+            "population": np.int64(self.population),
+            "mode": self.mode,
+            "seed": np.int64(self.seed),
+            "selection_counts": self.selection_counts.copy(),
+            "rounds_committed": np.int64(self.rounds_committed),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        pop = int(state["population"])
+        mode = str(state["mode"])
+        if pop != self.population or mode != self.mode:
+            raise ValueError(
+                f"sampler state mismatch: saved (population={pop}, "
+                f"mode={mode!r}) vs configured "
+                f"(population={self.population}, mode={self.mode!r}) — the "
+                "snapshot was written under a different fed.population "
+                "config"
+            )
+        if int(state["seed"]) != self.seed:
+            print(
+                "[sampling] WARNING: restored sampler seed "
+                f"{int(state['seed'])} != configured {self.seed}; the "
+                "resumed schedule follows the CONFIGURED seed"
+            )
+        counts = np.asarray(state["selection_counts"], np.int64)
+        if counts.shape != (self.population,):
+            raise ValueError(
+                f"restored selection_counts shape {counts.shape} != "
+                f"({self.population},)"
+            )
+        self.selection_counts = counts.copy()
+        self.rounds_committed = int(state["rounds_committed"])

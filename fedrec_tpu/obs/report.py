@@ -305,6 +305,27 @@ def build_report(
         if rb:
             report["robustness"] = rb
 
+        # ---- participation: the cohort engine's view of the round —
+        # population size, last cohort draw/report counts, cumulative
+        # dropout/deadline/quorum events, slot churn, coverage. Keyed on
+        # fed.population_clients > 0 so a cross-silo run stays silent.
+        pop_size = snapshot_value(last, "fed.population_clients")
+        if pop_size:
+            part: dict[str, Any] = {"population": pop_size}
+            for key, name in (
+                ("cohort_sampled", "fed.cohort_sampled"),
+                ("cohort_reporting", "fed.cohort_reporting"),
+                ("dropouts", "fed.pop_dropouts_total"),
+                ("deadline_cuts", "fed.deadline_cuts_total"),
+                ("quorum_replays", "fed.quorum_replays_total"),
+                ("slot_swaps", "fed.cohort_slot_swaps_total"),
+                ("coverage", "fed.population_coverage"),
+            ):
+                v = snapshot_value(last, name)
+                if v is not None:
+                    part[key] = v
+            report["participation"] = part
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -425,6 +446,28 @@ def render_text(report: dict) -> str:
                 f"rollbacks: {int(rb.get('rollbacks', 0))}, "
                 f"active: {int(rb.get('quarantine_active', 0))}"
             )
+        lines.append("")
+    part = report.get("participation")
+    if part:
+        lines.append("## Participation")
+        lines.append(
+            f"logical clients: {int(part['population'])}"
+            + (
+                f", coverage: {part['coverage']:.1%}"
+                if "coverage" in part else ""
+            )
+        )
+        if "cohort_sampled" in part or "cohort_reporting" in part:
+            lines.append(
+                f"last round: sampled={int(part.get('cohort_sampled', 0))} "
+                f"reporting={int(part.get('cohort_reporting', 0))}"
+            )
+        lines.append(
+            f"dropouts: {int(part.get('dropouts', 0))}, "
+            f"deadline cuts: {int(part.get('deadline_cuts', 0))}, "
+            f"quorum replays: {int(part.get('quorum_replays', 0))}, "
+            f"slot swaps: {int(part.get('slot_swaps', 0))}"
+        )
         lines.append("")
     if "cap_overflow_steps" in report:
         lines.append(f"cap-overflow steps: {int(report['cap_overflow_steps'])}")

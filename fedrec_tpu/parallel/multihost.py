@@ -307,10 +307,20 @@ class CoordinatorRuntime:
         collective_timeout_s: float | None = None,
         compress: str = "none",
         robust: Any = None,
+        round_deadline_s: float | None = None,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
         self.collective_timeout_s = collective_timeout_s
+        # cross-device round deadline (fed.population.round_deadline_ms):
+        # bounds the round-end AGGREGATION gather specifically — a peer
+        # that has not contributed by the deadline has missed the round.
+        # A missed gather degrades this host to standalone (collectives
+        # are ordered; a partial gather cannot be resumed), but bounded:
+        # the reference instead blocks until its 2-day gloo timeout.
+        self.round_deadline_s = round_deadline_s
+        self.deadline_misses = 0
+        self.degraded_by_timeout = False
         self.compress = validate_compress(compress)
         self.robust = robust  # fed.robust section; None/mean = plain FedAvg
         self.degraded = False
@@ -326,13 +336,23 @@ class CoordinatorRuntime:
     def is_server(self) -> bool:
         return self.process_id == 0
 
-    def _collective(self, fn: Callable[[], Any], fallback: Callable[[], Any]) -> Any:
+    def _collective(
+        self,
+        fn: Callable[[], Any],
+        fallback: Callable[[], Any],
+        timeout_s: float | None = None,
+        kind: str = "collective",
+    ) -> Any:
         """Run one DCN collective under the watchdog; local fallback after
-        the world is known-broken. The abandoned worker thread stays blocked
-        in the dead collective — it is a daemon and never rejoined."""
+        the world is known-broken. ``timeout_s`` overrides the runtime
+        watchdog for THIS call (the round-deadline bound on the aggregate
+        gather); ``kind`` labels the failure for the operator. The
+        abandoned worker thread stays blocked in the dead collective — it
+        is a daemon and never rejoined."""
         if self.degraded:
             return fallback()
-        if not self.collective_timeout_s:
+        timeout = timeout_s if timeout_s is not None else self.collective_timeout_s
+        if not timeout:
             return fn()
         box: list = []
         errs: list = []
@@ -345,17 +365,19 @@ class CoordinatorRuntime:
 
         t = threading.Thread(target=target, daemon=True)
         t.start()
-        t.join(self.collective_timeout_s)
+        t.join(timeout)
         if t.is_alive() or errs:
+            timed_out = t.is_alive()
             why = f"error: {errs[0]!r}" if errs else (
-                f"timeout after {self.collective_timeout_s}s"
+                f"timeout after {timeout}s"
             )
             print(
-                f"[multihost] process {self.process_id}: collective failed "
+                f"[multihost] process {self.process_id}: {kind} failed "
                 f"({why}); degrading to standalone training for the "
                 "remaining rounds"
             )
             self.degraded = True
+            self.degraded_by_timeout = timed_out
             return fallback()
         return box[0]
 
@@ -388,17 +410,44 @@ class CoordinatorRuntime:
         aggregation mass (e.g. its example count for classic FedAvg);
         non-participants contribute 0 regardless. ``base`` (the round-start
         global every process holds) switches int8 compression to tighter
-        delta quantization — see :func:`aggregate_from_hosts`."""
+        delta quantization — see :func:`aggregate_from_hosts`.
+
+        When ``round_deadline_s`` is set, THIS collective — the round's
+        report-collection point — is bounded by it (taking precedence over
+        the general watchdog): a gather still incomplete at the deadline
+        counts a ``deadline_miss``, keeps local params for the round, and
+        degrades the host (collectives are ordered, so a partial gather
+        cannot be resumed — bounded, never wedged)."""
         if self.num_processes == 1:
             return params
         w = float(weight) if participated else 0.0
-        return self._collective(
+        deadline = self.round_deadline_s
+        before = self.degraded
+        out = self._collective(
             lambda: aggregate_from_hosts(
                 params, w, compress=self.compress, base=base,
                 robust=self.robust,
             ),
             lambda: params,
+            timeout_s=deadline if deadline else None,
+            kind=(
+                f"round aggregation (deadline {deadline}s)"
+                if deadline else "collective"
+            ),
         )
+        if (
+            deadline and self.degraded and not before
+            and self.degraded_by_timeout  # an ERROR is a peer failure,
+        ):                                # not a deadline cut
+            self.deadline_misses += 1
+            from fedrec_tpu.obs import get_registry
+
+            get_registry().counter(
+                "fed.dcn_deadline_misses_total",
+                "round-end DCN gathers cut at the round deadline "
+                "(host degraded to standalone)",
+            ).inc()
+        return out
 
     def _synchronized_shutdown(self) -> None:
         """Healthy-world teardown: barrier, clients disconnect, server last.

@@ -4,6 +4,7 @@ from fedrec_tpu.privacy.accountant import (
     compute_epsilon,
     compute_rdp_subsampled_gaussian,
     round_epsilon_schedule,
+    sampling_profile,
 )
 from fedrec_tpu.privacy.dpsgd import (
     clip_by_global_norm_per_example,
@@ -22,4 +23,5 @@ __all__ = [
     "make_noise_fn",
     "per_example_clipped_grads",
     "round_epsilon_schedule",
+    "sampling_profile",
 ]

@@ -119,6 +119,51 @@ def calibrate_sigma(
     return hi
 
 
+def sampling_profile(cfg, n_train: int) -> tuple[float, int]:
+    """The ONE definition of ``(q, steps_per_epoch)`` for the accountant —
+    calibration and the spend-side schedule must agree or the budget
+    silently diverges.
+
+    Fixed-world (no ``fed.population``): every client trains every round,
+    so the only subsampling is the batch draw — ``q = B / per_client``
+    with ``per_client = n_train // fed.num_clients``.
+
+    Sampled-world (``fed.population.num_clients`` above the slot count):
+    privacy is amplified TWICE — a client's data only enters a round when
+    the client is sampled (``q_client = slots / population``, the
+    per-round cohort fraction; over-selected spares never exceed the slot
+    count so this bounds the participating fraction), and within a
+    selected round only a batch of its shard is touched
+    (``q_batch = B / shard_size``). The two Poisson subsamplings compose
+    multiplicatively: ``q = q_client * q_batch`` — accounting each step at
+    the batch-level constant alone would overstate the privacy spend of a
+    sampled run by the full cohort fraction. Step counts are per SELECTED
+    round: ``shard_size // B`` steps per local epoch.
+    """
+    n_train = max(int(n_train), 1)
+    pop = getattr(cfg.fed, "population", None)
+    pop_n = int(getattr(pop, "num_clients", 0) or 0)
+    if pop_n > cfg.fed.num_clients:
+        if getattr(pop, "sampler", "uniform") != "uniform":
+            raise ValueError(
+                "privacy amplification-by-subsampling assumes a UNIFORM "
+                f"cohort draw; fed.population.sampler={pop.sampler!r} "
+                "biases per-client selection probability, so q = slots/"
+                "population would understate epsilon for over-selected "
+                "clients. Use sampler=uniform when privacy is enabled."
+            )
+        shard = max(n_train // pop_n, 1)
+        q_batch = min(1.0, cfg.data.batch_size / shard)
+        q_client = cfg.fed.num_clients / pop_n
+        q = min(1.0, q_client * q_batch)
+        steps_per_epoch = max(shard // cfg.data.batch_size, 1)
+        return q, steps_per_epoch
+    per_client = max(n_train // cfg.fed.num_clients, 1)
+    q = min(1.0, cfg.data.batch_size / per_client)
+    steps_per_epoch = max(per_client // cfg.data.batch_size, 1)
+    return q, steps_per_epoch
+
+
 def round_epsilon_schedule(cfg, n_train: int):
     """``rounds_done -> epsilon`` for the run's actual step cadence.
 
@@ -138,12 +183,8 @@ def round_epsilon_schedule(cfg, n_train: int):
             "privacy.sigma not set; calibrate it (calibrate_from_config) "
             "before asking for a spent-epsilon schedule"
         )
-    n_train = max(int(n_train), 1)
-    per_client = max(n_train // cfg.fed.num_clients, 1)
-    q = min(1.0, cfg.data.batch_size / per_client)
-    steps_per_round = (
-        max(per_client // cfg.data.batch_size, 1) * cfg.fed.local_epochs
-    )
+    q, steps_per_epoch = sampling_profile(cfg, n_train)
+    steps_per_round = steps_per_epoch * cfg.fed.local_epochs
     delta = cfg.privacy.delta
     cache: dict[int, float] = {}
 
@@ -163,14 +204,13 @@ def round_epsilon_schedule(cfg, n_train: int):
 def calibrate_from_config(cfg, n_train: int) -> float:
     """Sigma for ``cfg.privacy`` given the total training-sample count.
 
-    One shared definition of the sample rate ``q`` (per-client batch over
-    per-client data) and accountant step count — the CLI drivers and the
-    accuracy loop must agree or their privacy budgets silently diverge.
+    One shared definition of the sample rate ``q`` and accountant step
+    count (:func:`sampling_profile` — population-aware: client sampling
+    amplifies ``q`` by the per-round cohort fraction) — the CLI drivers
+    and the accuracy loop must agree or their privacy budgets silently
+    diverge.
     """
-    n_train = max(int(n_train), 1)
-    per_client = max(n_train // cfg.fed.num_clients, 1)
-    q = min(1.0, cfg.data.batch_size / per_client)
-    steps_per_epoch = max(per_client // cfg.data.batch_size, 1)
+    q, steps_per_epoch = sampling_profile(cfg, n_train)
     return calibrate_sigma(
         cfg.privacy.epsilon,
         cfg.privacy.delta,

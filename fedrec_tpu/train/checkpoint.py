@@ -197,3 +197,44 @@ def atomic_write_bytes(path: Path, blob: bytes) -> None:
     tmp = path.with_suffix(path.suffix + ".tmp")
     tmp.write_bytes(blob)
     os.replace(tmp, path)
+
+
+POPULATION_SIDECAR = "population_state.msgpack"
+
+
+def population_state_bytes(
+    sampler_state: dict,
+    ledger_state: dict,
+    slot_occupants: np.ndarray,
+    slot_writeback: np.ndarray,
+    round_idx: int,
+) -> bytes:
+    """Serialize the cohort engine's schedule-defining state — the
+    sampler's fairness counters, the participation ledger (incl.
+    quarantine expiries), and the current slot occupancy — as the
+    ``population_state.msgpack`` snapshot sidecar. Round-tagged like the
+    FedOpt sidecar so a loader can detect a sidecar that does not match
+    the snapshot it resumes from. Restoring it makes the post-resume
+    cohort SCHEDULE identical to an uninterrupted run
+    (``tests/test_population.py``); per-client optimizer sidecars are
+    deliberately not included (cross-device clients are cheap to restart
+    from the template — documented in docs/OPERATIONS.md)."""
+    from flax import serialization
+
+    return serialization.to_bytes({
+        "sampler": sampler_state,
+        "ledger": ledger_state,
+        "slot_occupants": np.asarray(slot_occupants, np.int64),
+        "slot_writeback": np.asarray(slot_writeback, bool),
+        "round": np.int64(round_idx),
+    })
+
+
+def load_population_state(blob: bytes) -> dict:
+    """Inverse of :func:`population_state_bytes` (msgpack is
+    self-describing, so no template is needed)."""
+    from flax import serialization
+
+    state = serialization.msgpack_restore(blob)
+    state["round"] = int(state["round"])
+    return state

@@ -227,6 +227,96 @@ class Trainer:
             self.valid_ix = index_samples(
                 data.valid_samples, data.nid2index, cfg.data.max_his_len
             )
+        self.train_ix = train_ix  # the population's shard substrate
+
+        # ---- cross-device cohort engine (fed.population): logical-client
+        # population sampled onto the fixed device slots each round.
+        # _pop_engine: any population config (bookkeeping + quorum/deadline);
+        # _pop_sampling: population STRICTLY above the slot count — real
+        # per-round sampling with per-client data shards and sidecar
+        # load/unload. population == slots is the degenerate (cross-silo)
+        # config: identity cohorts, the legacy data path, bit-identical
+        # trajectory (tests/test_population.py).
+        from pathlib import Path as _Path
+
+        pcfg = cfg.fed.population
+        self._pop_engine = pcfg.num_clients > 0
+        self._pop_sampling = pcfg.num_clients > cfg.fed.num_clients
+        self.population = None
+        self.cohort_sampler = None
+        self._current_plan = None
+        self._pop_pending: dict[int, tuple] = {}
+        self._pop_attempts: dict[int, int] = {}
+        self.cohort_history: list[tuple[int, tuple]] = []
+        self._slot_occupants = np.arange(cfg.fed.num_clients, dtype=np.int64)
+        self._slot_writeback = np.ones(cfg.fed.num_clients, bool)
+        self._recovery_occupants = None
+        self._pop_template = None
+        if self._pop_engine:
+            from fedrec_tpu.fed.population import ClientPopulation
+            from fedrec_tpu.fed.sampling import (
+                CohortSampler,
+                validate_sampler_mode,
+            )
+
+            validate_sampler_mode(pcfg.sampler)
+            if pcfg.num_clients < cfg.fed.num_clients:
+                raise ValueError(
+                    f"fed.population.num_clients={pcfg.num_clients} is below "
+                    f"the device-slot count fed.num_clients="
+                    f"{cfg.fed.num_clients}; the population must cover every "
+                    "slot (== slots is the degenerate cross-silo config)"
+                )
+            if pcfg.over_select < 1.0:
+                raise ValueError(
+                    f"fed.population.over_select={pcfg.over_select} must be "
+                    ">= 1.0 (1.0 = no over-selection)"
+                )
+            if pcfg.client_state not in ("persist", "reset"):
+                raise ValueError(
+                    f"fed.population.client_state={pcfg.client_state!r}; "
+                    "expected 'persist' or 'reset'"
+                )
+            if pcfg.min_reports > cfg.fed.num_clients:
+                raise ValueError(
+                    f"fed.population.min_reports={pcfg.min_reports} exceeds "
+                    f"the slot count {cfg.fed.num_clients}: the quorum could "
+                    "never be met"
+                )
+            if self._pop_sampling:
+                if not self.strategy.sync_params_every_round:
+                    raise ValueError(
+                        "fed.population sampling (num_clients above the slot "
+                        "count) requires a param-syncing strategy (param_avg "
+                        "or coordinator): sampled-in clients adopt the "
+                        f"global at round end; fed.strategy="
+                        f"{cfg.fed.strategy!r} never distributes one"
+                    )
+                if cfg.fed.participation < 1.0:
+                    raise ValueError(
+                        "fed.participation < 1.0 composes with the FIXED "
+                        "cohort only; under fed.population sampling the "
+                        "cohort draw IS the participation policy — leave "
+                        "fed.participation at 1.0"
+                    )
+            spill = pcfg.spill_dir or None
+            if not spill:
+                snap = snapshot_dir or cfg.train.snapshot_dir
+                spill = str(_Path(snap) / "popspill") if snap else None
+            self.population = ClientPopulation(
+                pcfg.num_clients,
+                len(train_ix),
+                data_seed=cfg.data.seed,
+                batch_size=cfg.data.batch_size if self._pop_sampling else 0,
+                resident_cap=pcfg.resident_cap,
+                spill_dir=spill,
+            )
+            self.cohort_sampler = CohortSampler(
+                pcfg.num_clients,
+                pcfg.sampler,
+                pcfg.seed,
+                sample_counts=self.population.sample_counts,
+            )
 
         # jitted programs. Batch-buffer donation (train.donate_batch) is
         # safe HERE because every dispatch device_puts fresh arrays; the
@@ -298,6 +388,21 @@ class Trainer:
         self.state = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sharding), stacked
         )
+        if self._pop_engine:
+            # the pristine sidecar template a never-before-selected (or
+            # quarantine-healed) logical client starts from: slot 0's
+            # freshly-initialized non-param leaves, captured BEFORE any
+            # restore/training touches the state (rng is re-derived per
+            # client in _template_sidecar)
+            from fedrec_tpu.fed.population import SIDECAR_FIELDS
+
+            host0 = jax.tree_util.tree_map(np.asarray, self.state)
+            self._pop_template = {
+                f: jax.tree_util.tree_map(
+                    lambda x: np.array(x[0]), getattr(host0, f)
+                )
+                for f in SIDECAR_FIELDS
+            }
 
         self.start_round = 0
         self.snapshots: SnapshotManager | None = None
@@ -357,6 +462,43 @@ class Trainer:
                                 f"{self.start_round - 1}; momentum may be "
                                 "skewed for the first resumed round"
                             )
+                if self._pop_engine:
+                    # the cohort engine's schedule-defining state: sampler
+                    # fairness counters + participation ledger + slot
+                    # occupancy — restoring it makes rounds r+1.. sample
+                    # IDENTICAL cohorts to an uninterrupted run
+                    from fedrec_tpu.train.checkpoint import (
+                        POPULATION_SIDECAR,
+                        load_population_state,
+                    )
+
+                    pop_sidecar = self.snapshots.directory / POPULATION_SIDECAR
+                    if pop_sidecar.exists():
+                        pst = load_population_state(pop_sidecar.read_bytes())
+                        self.cohort_sampler.load_state_dict(pst["sampler"])
+                        self.population.ledger.load_state_dict(pst["ledger"])
+                        self._slot_occupants = np.asarray(
+                            pst["slot_occupants"], np.int64
+                        )
+                        self._slot_writeback = np.asarray(
+                            pst["slot_writeback"], bool
+                        )
+                        if pst["round"] != self.start_round - 1:
+                            print(
+                                f"[trainer] population sidecar from round "
+                                f"{pst['round']} != snapshot round "
+                                f"{self.start_round - 1}; the cohort "
+                                "schedule may be skewed for the first "
+                                "resumed rounds"
+                            )
+                    elif self._pop_sampling:
+                        print(
+                            "[trainer] WARNING: resuming a fed.population "
+                            f"run without {POPULATION_SIDECAR} — the "
+                            "sampler/ledger restart fresh, so the resumed "
+                            "cohort schedule will differ from an "
+                            "uninterrupted run"
+                        )
             try:
                 # resolved config rides with the snapshots so serving can
                 # rebuild the exact model without the operator re-typing
@@ -462,6 +604,42 @@ class Trainer:
             "faults injected by the chaos FaultPlan, labeled by kind "
             "(drop/straggle/nan/scale/flip); rollback replays re-count",
             labels=("kind",),
+        )
+        # ---- cohort-engine instruments (fedrec-obs report's Participation
+        # section): zero-valued when fed.population is off
+        self._g_pop_size = self.registry.gauge(
+            "fed.population_clients",
+            "configured logical-client population (0 = cross-silo)",
+        )
+        self._g_pop_size.set(float(cfg.fed.population.num_clients))
+        self._g_cohort_sampled = self.registry.gauge(
+            "fed.cohort_sampled",
+            "clients drawn for the current round, over-selection included",
+        )
+        self._g_cohort_reporting = self.registry.gauge(
+            "fed.cohort_reporting",
+            "clients whose round weight survived dropout and the deadline",
+        )
+        self._m_pop_drops = self.registry.counter(
+            "fed.pop_dropouts_total",
+            "sampled clients that dropped out of their round",
+        )
+        self._m_deadline_cuts = self.registry.counter(
+            "fed.deadline_cuts_total",
+            "clients cut at the round deadline (weight 0, work discarded)",
+        )
+        self._m_quorum_replays = self.registry.counter(
+            "fed.quorum_replays_total",
+            "rounds discarded below min_reports and replayed with a "
+            "fresh cohort draw",
+        )
+        self._m_cohort_swaps = self.registry.counter(
+            "fed.cohort_slot_swaps_total",
+            "device-slot sidecar load/unload operations (cohort churn)",
+        )
+        self._g_pop_coverage = self.registry.gauge(
+            "fed.population_coverage",
+            "fraction of the population selected at least once",
         )
         # spent-epsilon trajectory: one gauge per round, next to loss/AUC.
         # Only the rigorous mechanism gets a trajectory — ldp_news carries
@@ -759,6 +937,22 @@ class Trainer:
         return self._table
 
     # ------------------------------------------------------------------
+    def _epoch_batches_source(self, epoch_idx: int):
+        """One local epoch's stacked (slots, B, ...) batches. Fixed world:
+        the legacy batcher re-deals the whole (local) corpus over the
+        client slots each epoch. Sampled world (``fed.population`` above
+        the slot count): slot *j* iterates the CURRENT cohort's client
+        ``j``'s own static shard — data follows the client, the premise of
+        cross-device federation."""
+        if self._pop_sampling:
+            return self.population.cohort_epoch_batches(
+                self._current_plan.slot_clients, self.train_ix,
+                self.cfg.data, epoch_idx,
+            )
+        return self.batcher.epoch_batches_sharded(
+            self.cfg.fed.num_clients, epoch_idx
+        )
+
     def _epoch_batch_iter(self, epoch_idx: int, extra: dict | None = None):
         """Epoch batches as step-ready dicts, built ahead on a bounded
         producer thread when ``data.prefetch_batches`` > 0 — batch t+1
@@ -769,9 +963,7 @@ class Trainer:
         fault vectors) is merged into every batch dict."""
         extra = extra or {}
         return maybe_prefetch(
-            self.batcher.epoch_batches_sharded(
-                self.cfg.fed.num_clients, epoch_idx
-            ),
+            self._epoch_batches_source(epoch_idx),
             self.cfg.data.prefetch_batches,
             transform=lambda b: {
                 "candidates": b.candidates,
@@ -908,6 +1100,8 @@ class Trainer:
         the coordinator driver's per-round entry point (``run`` applies
         the same policy around whole chunks). Without
         ``fed.robust.recover`` this is exactly :meth:`train_round`."""
+        from fedrec_tpu.fed.population import QuorumFailure
+
         while True:
             self._capture_recovery_state()
             try:
@@ -915,7 +1109,11 @@ class Trainer:
             except RoundRecovery as e:
                 self._rollback_and_quarantine(e.trigger, round_idx)
                 continue
+            except QuorumFailure as e:
+                self._handle_quorum_failure(e, round_idx)
+                continue
             self._round_retries = 0
+            self._commit_population(round_idx)
             self._tick_quarantine()
             return result
 
@@ -927,6 +1125,15 @@ class Trainer:
         if not self.cfg.fed.robust.recover:
             return
         self._recovery_state = self._host_state()
+        if self._pop_engine:
+            # occupancy must roll back WITH the state: a replayed round
+            # re-installs its cohort against the restored slots, and a
+            # stale occupancy map would write one client's sidecar back
+            # under another's id
+            self._recovery_occupants = (
+                self._slot_occupants.copy(),
+                self._slot_writeback.copy(),
+            )
         if self.server_opt is not None:
             import copy
 
@@ -942,25 +1149,58 @@ class Trainer:
         client = int(trigger["client"])
         kind = str(trigger.get("kind"))
         self._round_retries += 1
-        self._quarantine[client] = max(
-            self._quarantine.get(client, 0), cfg.fed.robust.quarantine_rounds
-        )
+        logical = None
+        if self._pop_sampling and self._current_plan is not None:
+            # the sentry flags a SLOT; quarantine the LOGICAL client that
+            # occupied it this round — the sampler excludes it from draws
+            # until the expiry round, and its (possibly poisoned) sidecar
+            # is reset so the healed rejoin restarts from the template
+            logical = int(self._current_plan.slot_clients[client])
+            self.population.ledger.quarantine(
+                logical, round_idx + cfg.fed.robust.quarantine_rounds
+            )
+            self.population.reset_sidecar(logical)
+            self._pop_pending = {
+                k: v for k, v in self._pop_pending.items() if k < round_idx
+            }
+            self._g_quarantined.set(
+                float(len(self.population.ledger.quarantined))
+            )
+        else:
+            self._quarantine[client] = max(
+                self._quarantine.get(client, 0),
+                cfg.fed.robust.quarantine_rounds,
+            )
+            self._g_quarantined.set(float(len(self._quarantine)))
         self._m_quarantines.inc()
         self._m_rollbacks.inc()
-        self._g_quarantined.set(float(len(self._quarantine)))
         self.tracer.add_span(
             "rollback", dur_s=0.0,
             round=int(trigger.get("round") or round_idx),
-            client=client, kind=kind, retry=self._round_retries,
+            client=client if logical is None else logical,
+            kind=kind, retry=self._round_retries,
+        )
+        who = (
+            f"client {client}" if logical is None
+            else f"logical client {logical} (slot {client})"
         )
         print(
-            f"[trainer] WARNING: health trigger [{kind}] on client {client} "
+            f"[trainer] WARNING: health trigger [{kind}] on {who} "
             f"at round {trigger.get('round')} — quarantining it for "
             f"{cfg.fed.robust.quarantine_rounds} round(s), rolling back to "
             f"the round-{round_idx} entry state and replaying (retry "
             f"{self._round_retries}/{cfg.fed.robust.max_retries})"
         )
         self.adopt_state(self._recovery_state)
+        if self._pop_engine and self._recovery_occupants is not None:
+            self._slot_occupants = self._recovery_occupants[0].copy()
+            self._slot_writeback = self._recovery_occupants[1].copy()
+            if logical is not None:
+                # the quarantined client's sidecar was reset above; without
+                # this, the replay's _install_cohort would write its
+                # restored (possibly poisoned) sidecar straight back and
+                # the healed rejoin would NOT restart from the template
+                self._slot_writeback[self._slot_occupants == logical] = False
         if self.server_opt is not None:
             import copy
 
@@ -972,6 +1212,12 @@ class Trainer:
         args: dict = {}
         if self._quarantine:
             args["quarantined"] = sorted(self._quarantine)
+        if self._pop_sampling and self._current_plan is not None:
+            args["cohort"] = int(self._current_plan.slot_real.sum())
+            if self.population.ledger.quarantined:
+                args["quarantined"] = sorted(
+                    self.population.ledger.quarantined
+                )
         if self._round_retries:
             args["replay_retry"] = self._round_retries
         return args
@@ -1078,21 +1324,56 @@ class Trainer:
         )
 
     def _round_weights(self, round_idx: int) -> np.ndarray:
-        """THE per-round aggregation weights: participation mask ×
-        chaos drop/straggle mask × quarantine exclusion — host-driven
-        rounds and rounds-in-jit chunks share this one composition.
-        Without chaos or quarantine it is exactly the participation mask
-        (value-identical to the pre-robust trajectory)."""
+        """THE per-round aggregation weights — host-driven rounds and
+        rounds-in-jit chunks share this one composition:
+
+        * fixed-world (no ``fed.population``): participation mask × chaos
+          slot drop/straggle mask × quarantine exclusion — without chaos
+          or quarantine exactly the participation mask (value-identical
+          to the pre-robust trajectory);
+        * cohort engine: the plan's per-slot report simulation (pads,
+          per-round dropouts, deadline cuts — :func:`plan_round_weights`)
+          × the same participation/chaos-slot composition, with the
+          quorum policy enforced on the FINAL reporting count (a
+          :class:`QuorumFailure` here is raised before any state
+          mutation, so the discarded round IS its entry state).
+        """
         cfg = self.cfg
         from fedrec_tpu.fed.strategies import participation_mask
 
-        w = np.asarray(
-            participation_mask(
-                self._mask_rng(round_idx), cfg.fed.num_clients,
-                cfg.fed.participation,
-            ),
-            np.float32,
-        )
+        plan = self._current_plan if self._pop_engine else None
+        events = None
+        if plan is not None:
+            from fedrec_tpu.fed.population import plan_round_weights
+
+            w, events = plan_round_weights(
+                plan, round_idx, cfg.fed.population.round_deadline_ms,
+                chaos=self.chaos,
+            )
+            if round_idx == plan.round_idx and plan.start_dropped.size:
+                # start-drops never reached a slot; the ledger still owes
+                # them a dropped round (over-selection's raison d'etre)
+                events["dropped"] = np.unique(
+                    np.concatenate([events["dropped"], plan.start_dropped])
+                )
+            if cfg.fed.participation < 1.0:
+                # degenerate-population composition: the legacy fraction
+                # still applies when the cohort is the fixed world
+                w = w * np.asarray(
+                    participation_mask(
+                        self._mask_rng(round_idx), cfg.fed.num_clients,
+                        cfg.fed.participation,
+                    ),
+                    np.float32,
+                )
+        else:
+            w = np.asarray(
+                participation_mask(
+                    self._mask_rng(round_idx), cfg.fed.num_clients,
+                    cfg.fed.participation,
+                ),
+                np.float32,
+            )
         if self.chaos is not None:
             rf = self.chaos.round_faults(round_idx)
             w = w * rf.weight_mask
@@ -1107,10 +1388,251 @@ class Trainer:
                 import time as _time
 
                 _time.sleep(cfg.chaos.straggle_ms / 1e3)
-        for c in self._quarantine:
-            if 0 <= c < w.shape[0]:
-                w[c] = 0.0
+        if not self._pop_sampling:
+            # slot-keyed quarantine (legacy + degenerate population); the
+            # sampling engine excludes quarantined LOGICAL clients at the
+            # cohort draw instead
+            for c in self._quarantine:
+                if 0 <= c < w.shape[0]:
+                    w[c] = 0.0
+        if plan is not None:
+            from fedrec_tpu.fed.population import QuorumFailure
+
+            # ledger truth = the FINAL weights (slot chaos included)
+            keep = (w > 0) & plan.slot_real
+            events["reported"] = np.unique(plan.slot_clients[keep])
+            # any real client whose weight hit zero for a reason the
+            # pop-level simulation didn't see (slot chaos, participation
+            # mask, slot quarantine) still owes the ledger a dropped
+            # round — otherwise selected > reported+dropped+cut and the
+            # sizing runbook's dropout metrics under-count real churn
+            lost = (
+                set(np.unique(plan.slot_clients[plan.slot_real & ~keep]).tolist())
+                - set(events["reported"].tolist())
+                - set(np.asarray(events["deadline_cut"]).tolist())
+                - set(np.asarray(events["dropped"]).tolist())
+            )
+            if lost:
+                events["dropped"] = np.unique(np.concatenate([
+                    np.asarray(events["dropped"], np.int64),
+                    np.asarray(sorted(lost), np.int64),
+                ]))
+            self._pop_pending[round_idx] = (plan, events)
+            reporting = int(events["reported"].size)
+            self._g_cohort_reporting.set(float(reporting))
+            mr = cfg.fed.population.min_reports
+            if 0 < mr and reporting < mr:
+                raise QuorumFailure(
+                    plan.round_idx, round_idx, reporting, mr, plan.attempt
+                )
         return w
+
+    # ------------------------------------------------- cohort engine
+    def _ensure_cohort(self, round_idx: int) -> None:
+        """Sample and install the cohort for ``round_idx`` (the draw
+        anchor — a rounds-in-jit chunk keeps one cohort for its whole
+        span, re-rolling only the per-round report weights). Re-entrant:
+        a rollback or quorum replay re-derives the plan — same
+        ``(seed, round, attempt)`` minus newly-quarantined clients —
+        and the install no-ops when the occupancy is unchanged."""
+        if not self._pop_engine:
+            return
+        from fedrec_tpu.fed.population import build_cohort_plan
+
+        pcfg = self.cfg.fed.population
+        exclude = (
+            self.population.ledger.active_quarantine(round_idx)
+            if self._pop_sampling
+            else ()
+        )
+        plan = build_cohort_plan(
+            self.cohort_sampler,
+            self.cfg.fed.num_clients,
+            round_idx,
+            pcfg.over_select,
+            chaos=self.chaos,
+            exclude=exclude,
+            attempt=self._pop_attempts.get(round_idx, 0),
+            pack=self._pop_sampling,
+        )
+        self._current_plan = plan
+        self._g_cohort_sampled.set(float(len(plan.sampled)))
+        if self._pop_sampling:
+            self._install_cohort(plan)
+
+    def _template_sidecar(self, client_id: int) -> dict:
+        """The pristine sidecar a first-time (or healed) client starts
+        from: zeroed optimizer moments + step 0 + a per-client PRNG fold
+        (logical clients get their own deterministic noise streams,
+        disjoint from the slot-init splits)."""
+        t = {
+            f: jax.tree_util.tree_map(np.array, v)
+            for f, v in self._pop_template.items()
+        }
+        t["rng"] = np.asarray(
+            jax.random.fold_in(
+                jax.random.PRNGKey(self.cfg.train.seed + 1),
+                (1 << 24) + int(client_id),
+            )
+        )
+        return t
+
+    def _install_cohort(self, plan) -> None:
+        """Load/unload around the round: write rotating-out occupants'
+        sidecars (optimizer states, PRNG, step, grad accumulator) back to
+        the population store, load the incoming clients' sidecars (or the
+        template on first selection) into their slots. Parameters are NOT
+        touched — after a param-avg sync every slot holds the global, which
+        is exactly what a sampled-in client adopts. Pad slots (weight 0)
+        load their duplicate's sidecar but never write back."""
+        from fedrec_tpu.fed.population import SIDECAR_FIELDS
+
+        slots = self.cfg.fed.num_clients
+        persist = self.cfg.fed.population.client_state == "persist"
+        new_occ = np.asarray(plan.slot_clients, np.int64)
+        new_wb = (plan.slot_real & persist).astype(bool)
+        changed = [
+            j for j in range(slots) if self._slot_occupants[j] != new_occ[j]
+        ]
+        if not changed:
+            self._slot_writeback = new_wb
+            return
+        # only the sidecar subtrees cross the host boundary — params and
+        # the rest of the state never change across an install (the
+        # post-sync global IS what a sampled-in client adopts), so a
+        # cohort swap costs sidecar-sized transfers, not a full-model
+        # D2H/H2D round-trip per round. np.array: writable host copies.
+        fields = {
+            f: jax.tree_util.tree_map(np.array, getattr(self.state, f))
+            for f in SIDECAR_FIELDS
+        }
+        if persist:
+            # write back EVERY persisted occupant, not only changed slots:
+            # a client can stay at its old index as a weight-0 pad while
+            # being re-packed real into a DIFFERENT slot — the store copy
+            # must be its freshest sidecar or the new slot loads stale
+            # moments and the round's training is silently discarded
+            for j in range(slots):
+                if self._slot_writeback[j]:
+                    self.population.put_sidecar(
+                        int(self._slot_occupants[j]),
+                        {
+                            f: jax.tree_util.tree_map(
+                                lambda x, _j=j: x[_j].copy(), fields[f]
+                            )
+                            for f in SIDECAR_FIELDS
+                        },
+                    )
+        for j in changed:
+            cid = int(new_occ[j])
+            sc = self.population.get_sidecar(cid) if persist else None
+            if sc is None:
+                sc = self._template_sidecar(cid)
+            for f in SIDECAR_FIELDS:
+                def put(dst, src, _j=j):
+                    dst[_j] = src
+                    return dst
+
+                jax.tree_util.tree_map(put, fields[f], sc[f])
+        self._m_cohort_swaps.inc(len(changed))
+        sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
+        self.state = self.state.replace(**{
+            f: jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.asarray(x), sharding),
+                fields[f],
+            )
+            for f in SIDECAR_FIELDS
+        })
+        self._slot_occupants = new_occ.copy()
+        self._slot_writeback = new_wb
+
+    def _commit_population(self, round_idx: int) -> None:
+        """Commit one COMPLETED round into the sampler's fairness state
+        and the participation ledger — called only once the round's
+        results are accepted, so rolled-back and quorum-discarded rounds
+        never skew the schedule."""
+        if not self._pop_engine:
+            return
+        pending = self._pop_pending.pop(round_idx, None)
+        if pending is None:
+            return
+        plan, events = pending
+        self.cohort_sampler.record(plan.sampled)
+        self.population.ledger.commit(plan.sampled, events)
+        for key, ctr in (
+            ("dropped", self._m_pop_drops),
+            ("deadline_cut", self._m_deadline_cuts),
+        ):
+            n = int(np.asarray(events.get(key, ())).size)
+            if n:
+                ctr.inc(n)
+        self._pop_attempts.pop(round_idx, None)
+        self._g_pop_coverage.set(self.population.ledger.coverage())
+        if self._pop_sampling:
+            self._g_quarantined.set(
+                float(len(self.population.ledger.quarantined))
+            )
+        self.cohort_history.append(
+            (
+                round_idx,
+                tuple(int(c) for c in plan.slot_clients[plan.slot_real]),
+            )
+        )
+
+    def _handle_quorum_failure(self, e, round_idx: int) -> None:
+        """One quorum-replay cycle: discard the round's pending ledger
+        events, bump the draw attempt for the anchor round (fresh cohort
+        + fresh fault dice next pass), abort once retries are exhausted.
+        The failure is raised before any dispatch, so 'replay from the
+        round-entry state' needs no state restore — the entry state was
+        never left."""
+        pcfg = self.cfg.fed.population
+        self._pop_pending = {
+            k: v for k, v in self._pop_pending.items() if k < round_idx
+        }
+        attempts = self._pop_attempts.get(e.anchor_round, 0) + 1
+        self._m_quorum_replays.inc()
+        self.tracer.add_span(
+            "quorum_replay", dur_s=0.0, round=e.round_idx,
+            reporting=e.reporting, attempt=attempts,
+        )
+        # a re-draw only helps if SOMETHING consumes the attempt counter:
+        # the cohort draw (sampled world) or the population-level fault
+        # dice. In the degenerate world without those, every replay
+        # recomputes byte-identical weights (slot chaos and the
+        # participation mask are keyed on round only) — burning retries
+        # would just delay the same abort.
+        ch = self.cfg.chaos
+        attempt_sensitive = self._pop_sampling or (
+            self.chaos is not None
+            and (
+                ch.pop_drop_rate > 0
+                or ch.pop_flaky_fraction > 0
+                or (ch.pop_straggle_ms > 0 and pcfg.round_deadline_ms > 0)
+            )
+        )
+        if attempts > pcfg.quorum_retries or not attempt_sensitive:
+            futile = (
+                "" if attempt_sensitive else
+                " (a fixed-world cohort with no population-level fault "
+                "dice replays identically — retries skipped)"
+            )
+            raise RuntimeError(
+                f"round {e.round_idx} failed quorum "
+                f"({e.reporting} reporting < min_reports="
+                f"{pcfg.min_reports}) on {attempts} consecutive cohort "
+                f"draws{futile} — the population's dropout rate cannot "
+                "sustain this quorum. Lower fed.population.min_reports, "
+                "raise over_select, or relax the deadline "
+                "(docs/OPERATIONS.md, 'sizing a cohort')."
+            ) from e
+        self._pop_attempts[e.anchor_round] = attempts
+        print(
+            f"[trainer] WARNING: quorum failure at round {e.round_idx} "
+            f"({e.reporting} < {pcfg.min_reports}); discarding the round "
+            f"and replaying with a fresh cohort draw (attempt {attempts}/"
+            f"{pcfg.quorum_retries})"
+        )
 
     def _chaos_batch_keys(self, round_idx: int) -> dict | None:
         """Per-client fault vectors every chaos-enabled batch must carry
@@ -1127,6 +1649,9 @@ class Trainer:
         import time as _time
 
         t0 = _time.perf_counter()
+        # cohort first (and before the span, whose args describe it): the
+        # draw + sidecar install define who this round even is
+        self._ensure_cohort(round_idx)
         with self.tracer.span(
             "fed_round", step_num=round_idx, num_rounds=1,
             **self._round_span_args(),
@@ -1408,6 +1933,11 @@ class Trainer:
         import time as _time
 
         t0 = _time.perf_counter()
+        # one cohort per CHUNK (the chunk's batch stack and state are fixed
+        # at entry; per-round report weights still re-roll inside) — cohort
+        # rotation under rounds-in-jit happens at chunk cadence, a
+        # documented divergence from the host-driven per-round rotation
+        self._ensure_cohort(round_idx)
         chunk_span = self.tracer.span(
             "fed_round", step_num=round_idx, num_rounds=num_rounds,
             **self._round_span_args(),
@@ -1453,9 +1983,10 @@ class Trainer:
                 chaos_extra = self._chaos_batch_keys(r) or {}
                 for local_epoch in range(cfg.fed.local_epochs):
                     epoch_idx = r * cfg.fed.local_epochs + local_epoch
-                    for b in self.batcher.epoch_batches_sharded(
-                        cfg.fed.num_clients, epoch_idx
-                    ):
+                    # sampled world: slot j iterates the CHUNK cohort's
+                    # client j's own shard (same source as the host-driven
+                    # path — _ensure_cohort above fixed the occupancy)
+                    for b in self._epoch_batches_source(epoch_idx):
                         batch = {
                             "candidates": b.candidates,
                             "history": b.history,
@@ -1659,6 +2190,8 @@ class Trainer:
     def run(self) -> list[RoundResult]:
         cfg = self.cfg
         history: list[RoundResult] = []
+        from fedrec_tpu.fed.population import QuorumFailure
+
         try:
             with profile_if(cfg.train.profile):
                 round_idx = self.start_round
@@ -1681,9 +2214,19 @@ class Trainer:
                     except RoundRecovery as e:
                         self._rollback_and_quarantine(e.trigger, round_idx)
                         continue  # replay the same round/chunk
+                    except QuorumFailure as e:
+                        # raised BEFORE any dispatch (weights are built at
+                        # round/chunk entry), so the round's entry state
+                        # was never left — replay is a fresh cohort draw
+                        self._handle_quorum_failure(e, round_idx)
+                        continue
                     self._round_retries = 0
                     for result in results:
                         history.append(result)
+                        # commit BEFORE _after_round: a save-cadence
+                        # snapshot's population sidecar must describe the
+                        # schedule INCLUDING this round
+                        self._commit_population(result.round_idx)
                         self._after_round(result)
                         self._tick_quarantine()
                     round_idx += len(results)
@@ -1802,8 +2345,13 @@ class Trainer:
             with self.tracer.span(
                 "checkpoint", round=round_idx, kind="cadence"
             ):
+                # blocking also under the cohort engine: the population
+                # sidecar (like FedOpt's) must never be newer than the
+                # snapshot it pairs with, or a crash between the two
+                # resumes round-r cohort schedule against round r-k params
                 self.snapshots.save(
-                    round_idx, self.state, wait=self.server_opt is not None
+                    round_idx, self.state,
+                    wait=self.server_opt is not None or self._pop_engine,
                 )
                 if self.server_opt is not None:
                     from fedrec_tpu.train.checkpoint import atomic_write_bytes
@@ -1811,6 +2359,23 @@ class Trainer:
                     atomic_write_bytes(
                         self.snapshots.directory / "server_opt_state.msgpack",
                         self.server_opt.state_bytes(round_idx),
+                    )
+                if self._pop_engine:
+                    from fedrec_tpu.train.checkpoint import (
+                        POPULATION_SIDECAR,
+                        atomic_write_bytes,
+                        population_state_bytes,
+                    )
+
+                    atomic_write_bytes(
+                        self.snapshots.directory / POPULATION_SIDECAR,
+                        population_state_bytes(
+                            self.cohort_sampler.state_dict(),
+                            self.population.ledger.state_dict(),
+                            self._slot_occupants,
+                            self._slot_writeback,
+                            round_idx,
+                        ),
                     )
         if (
             self._obs_dir is not None

@@ -13,6 +13,12 @@
 #   4. RECOVERY: an injected nan-update with fed.robust.recover=true —
 #      quarantine + rollback + a completed run (no flight-recorder
 #      abort), rollback visible in the registry counters.
+#   5. POPULATION (ISSUE-6): 1024 logical clients sampled 64/round onto
+#      the 8x8 slot mesh under 20% seeded dropout + lognormal straggle +
+#      a 200ms round deadline and a 16-report quorum — must survive all
+#      rounds with finite losses, over-selection visible (80 sampled),
+#      dropouts/deadline-cuts counted, quorum held, and the whole run
+#      (losses AND churn counters) bit-identical on re-run.
 #
 #   scripts/chaos_smoke.sh     # or: make chaos-smoke
 #
@@ -43,28 +49,28 @@ CHAOS=(
     --set obs.health.abort_on_nonfinite=false
 )
 
-echo "== [1/4] fault-free trimmed-mean baseline =="
+echo "== [1/5] fault-free trimmed-mean baseline =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/baseline" "${SMALL[@]}" \
     --set train.snapshot_dir="$OUT/base_snap" \
     > "$OUT/baseline.log" 2>&1 || { tail -30 "$OUT/baseline.log"; exit 1; }
 
-echo "== [2/4] chaos run: 30% dropout + nan client + x100 poison client =="
+echo "== [2/5] chaos run: 30% dropout + nan client + x100 poison client =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/chaos_a" "${SMALL[@]}" "${CHAOS[@]}" \
     --set train.snapshot_dir="$OUT/chaos_a_snap" \
     > "$OUT/chaos_a.log" 2>&1 || { tail -30 "$OUT/chaos_a.log"; exit 1; }
 
-echo "== [3/4] determinism: same plan, bit-identical trajectory =="
+echo "== [3/5] determinism: same plan, bit-identical trajectory =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/chaos_b" "${SMALL[@]}" "${CHAOS[@]}" \
     --set train.snapshot_dir="$OUT/chaos_b_snap" \
     > "$OUT/chaos_b.log" 2>&1 || { tail -30 "$OUT/chaos_b.log"; exit 1; }
 
-echo "== [4/4] recovery: nan client + fed.robust.recover=true =="
+echo "== [4/5] recovery: nan client + fed.robust.recover=true =="
 run python -m fedrec_tpu.cli.run 4 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/recover" "${SMALL[@]}" \
@@ -72,6 +78,28 @@ run python -m fedrec_tpu.cli.run 4 8 10 --strategy param_avg --clients 8 \
     --set fed.robust.recover=true \
     --set train.snapshot_dir="$OUT/recover_snap" \
     > "$OUT/recover.log" 2>&1 || { tail -30 "$OUT/recover.log"; exit 1; }
+
+POP=(
+    --set fed.population.num_clients=1024
+    --set fed.population.over_select=1.25
+    --set fed.population.round_deadline_ms=200
+    --set fed.population.min_reports=16
+    --set fed.population.seed=11
+    --set chaos.enabled=true --set chaos.seed=13
+    --set chaos.pop_drop_rate=0.2 --set chaos.pop_straggle_ms=50
+)
+
+echo "== [5/5] population: 1024 logical clients, 64/round, 20% dropout =="
+run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
+    --mode joint --synthetic --synthetic-train 2048 --synthetic-news 64 \
+    --obs-dir "$OUT/pop_a" "${SMALL[@]}" "${POP[@]}" \
+    --set train.snapshot_dir="$OUT/pop_a_snap" \
+    > "$OUT/pop_a.log" 2>&1 || { tail -30 "$OUT/pop_a.log"; exit 1; }
+run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
+    --mode joint --synthetic --synthetic-train 2048 --synthetic-news 64 \
+    --obs-dir "$OUT/pop_b" "${SMALL[@]}" "${POP[@]}" \
+    --set train.snapshot_dir="$OUT/pop_b_snap" \
+    > "$OUT/pop_b.log" 2>&1 || { tail -30 "$OUT/pop_b.log"; exit 1; }
 
 run python - "$OUT" <<'EOF'
 import json, math, sys
@@ -109,10 +137,30 @@ rrb = build_report(rec_records, rec_snaps)["robustness"]
 assert rrb.get("rollbacks", 0) >= 1 and rrb.get("quarantines", 0) >= 1, rrb
 rec = losses("recover")
 assert len(rec) == 4 and all(map(math.isfinite, rec)), rec
+import math as _math
+pa, pb = losses("pop_a"), losses("pop_b")
+assert len(pa) == 3 and all(map(_math.isfinite, pa)), f"population run not finite: {pa}"
+assert pa == pb, f"population trajectory not bit-identical:\n{pa}\n{pb}"
+
+def pop_part(d):
+    records, snaps = load_jsonl(out / d / "metrics.jsonl")
+    return build_report(records, snaps).get("participation")
+
+part_a, part_b = pop_part("pop_a"), pop_part("pop_b")
+assert part_a and part_a["population"] == 1024, part_a
+assert part_a["cohort_sampled"] == 80, part_a           # ceil(64 * 1.25)
+assert part_a["cohort_reporting"] >= 16, part_a         # quorum held
+assert part_a.get("dropouts", 0) > 0, part_a            # churn visible
+assert part_a == part_b, f"population churn not bit-identical:\n{part_a}\n{part_b}"
+
 print("chaos smoke OK")
 print(f"  baseline   losses: {base}")
 print(f"  chaos      losses: {a}  (bit-identical on re-run)")
 print(f"  recovery   losses: {rec}  rollbacks={rrb['rollbacks']:.0f} quarantines={rrb['quarantines']:.0f}")
+print(f"  population losses: {pa}  (bit-identical on re-run)")
+print(f"  population churn : sampled={part_a['cohort_sampled']:.0f} reporting={part_a['cohort_reporting']:.0f} "
+      f"dropouts={part_a.get('dropouts', 0):.0f} deadline_cuts={part_a.get('deadline_cuts', 0):.0f} "
+      f"coverage={part_a.get('coverage', 0):.1%}")
 EOF
 
 echo "chaos smoke PASSED; artifacts in $OUT"

@@ -66,6 +66,58 @@ def test_accountant_rejects_bad_inputs():
         calibrate_sigma(-1.0, 1e-5, 0.1, 10)
 
 
+def test_sampling_profile_exact_q():
+    """ISSUE 6 satellite pin: when client sampling is on, the accountant's
+    subsampling fraction is the PRODUCT of the per-round cohort fraction
+    (slots / population) and the per-shard batch fraction — hand-exact —
+    and every accountant entry point (calibration, spend schedule) shares
+    that one definition."""
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.privacy import round_epsilon_schedule, sampling_profile
+
+    cfg = ExperimentConfig()
+    cfg.data.batch_size = 8
+    cfg.fed.num_clients = 4
+    n_train = 4096
+
+    # fixed world: q is the legacy batch-level constant
+    q, steps = sampling_profile(cfg, n_train)
+    assert q == 8 / (4096 // 4)            # B / per_client = 1/128
+    assert steps == (4096 // 4) // 8       # 128 steps/epoch
+
+    # sampled world: 64 logical clients on 4 slots
+    cfg.fed.population.num_clients = 64
+    q_s, steps_s = sampling_profile(cfg, n_train)
+    shard = 4096 // 64                     # 64 rows/client
+    assert q_s == (4 / 64) * (8 / shard)   # q_client * q_batch = 1/128
+    assert steps_s == shard // 8           # 8 steps per SELECTED epoch
+
+    # amplification is real: accounting the sampled run at the batch-level
+    # constant alone (same q here by construction, but 16x the steps, the
+    # fixed-world cadence) overstates the spend
+    cfg.privacy.sigma = 1.2
+    sched = round_epsilon_schedule(cfg, n_train)
+    eps_sampled = sched(10)
+    from fedrec_tpu.privacy.accountant import compute_epsilon
+
+    eps_fixed_cadence = compute_epsilon(
+        q_s, 1.2, steps * cfg.fed.local_epochs * 10, cfg.privacy.delta
+    )
+    assert eps_sampled < eps_fixed_cadence
+
+    # degenerate population (== slots) keeps the legacy profile exactly
+    cfg.fed.population.num_clients = 4
+    assert sampling_profile(cfg, n_train) == (q, steps)
+
+    # amplification assumes a UNIFORM draw: biased samplers are rejected
+    # (their per-client selection probability can approach 1, so
+    # q = slots/population would understate epsilon)
+    cfg.fed.population.num_clients = 64
+    cfg.fed.population.sampler = "weighted"
+    with pytest.raises(ValueError, match="UNIFORM"):
+        sampling_profile(cfg, n_train)
+
+
 # ---------------------------------------------------------------- clipping
 def test_per_example_clip_bounds_global_norm():
     rng = np.random.default_rng(0)
