@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 native obs-smoke chaos-smoke
+.PHONY: t1 native obs-smoke chaos-smoke comm-cost
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -17,6 +17,12 @@ obs-smoke:
 # recovery leg quarantines + rolls back instead of aborting
 chaos-smoke:
 	@bash scripts/chaos_smoke.sh
+
+# communication-cost benchmark: measured per-codec wire buffers of the
+# flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);
+# banks benchmarks/comm_cost.json
+comm-cost:
+	@python benchmarks/comm_cost.py
 
 native:
 	$(MAKE) -C native

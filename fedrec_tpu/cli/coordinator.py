@@ -225,18 +225,31 @@ def main(argv: list[str] | None = None) -> int:
     cfg.apply_overrides(args.overrides)
 
     if cfg.fed.robust.method != "mean" and cfg.fed.dcn_compress != "none":
-        # fail FAST (same policy as validate_compress): raised lazily inside
-        # the aggregation collective, this would be misread by the watchdog
-        # as a peer failure and silently degrade every host to standalone
-        raise ValueError(
-            f"fed.robust.method={cfg.fed.robust.method!r} requires "
-            "fed.dcn_compress='none' (robust reduction over quantized "
-            "contributions would trim rounding noise, not clients)"
-        )
+        # robust x compress is LEGAL for every registered codec: the gather
+        # decodes each contribution per process BEFORE any reduction
+        # (decode-before-reduce, fedrec_tpu.comms), so trimmed-mean/median
+        # judge clients, not quantization noise. The fail-fast survives only
+        # for a codec whose contributions cannot be decoded individually —
+        # checked HERE (same policy as validate_compress): raised lazily
+        # inside the aggregation collective, it would be misread by the
+        # watchdog as a peer failure and silently degrade every host to
+        # standalone training.
+        from fedrec_tpu.comms import codec_decodes_per_contribution
+
+        if not codec_decodes_per_contribution(cfg.fed.dcn_compress):
+            raise ValueError(
+                f"fed.robust.method={cfg.fed.robust.method!r} needs "
+                "per-contribution decode, which codec "
+                f"{cfg.fed.dcn_compress!r} cannot provide; use one of the "
+                "decodable codecs (int8/sign1bit/topk) or "
+                "fed.robust.method='mean'"
+            )
     rt = CoordinatorRuntime(
         collective_timeout_s=args.collective_timeout or None,
         compress=cfg.fed.dcn_compress,
         robust=cfg.fed.robust,
+        topk_ratio=cfg.fed.dcn_topk_ratio,
+        error_feedback=cfg.fed.dcn_error_feedback,
         # cross-device round deadline: bound the round-end report gather
         # (fed.population.round_deadline_ms) so a straggling peer costs a
         # bounded wait, never a wedged run. NOTE this is a REAL wall-clock
@@ -317,6 +330,34 @@ def main(argv: list[str] | None = None) -> int:
             f"{cfg.data.shard_index + 1}/{cfg.data.num_shards}: "
             f"{trainer.num_local_samples} samples"
         )
+
+    codec_snap = None
+    if msgpack_snapshots and rt.codec_state is not None:
+        # biased-codec (sign1bit/topk) error-feedback residual: THIS
+        # process's wire-endpoint EF state, persisted at save cadence so a
+        # resumed run keeps carrying the mass its encodes dropped. A
+        # missing/corrupt sidecar just starts the residual from zero — the
+        # same bounded-staleness contract as a fresh logical client.
+        codec_snap = snapshot_dir / f"codec_state_p{rt.process_id}.npz"
+        if cfg.train.resume and codec_snap.exists():
+            from fedrec_tpu.comms import load_codec_state
+
+            try:
+                rt.codec_state, ef_round = load_codec_state(
+                    codec_snap.read_bytes(), trainer._client0_params()
+                )
+                print(
+                    f"[coordinator] process {rt.process_id} resumed codec "
+                    f"residual from round {ef_round}"
+                )
+            except Exception as e:  # noqa: BLE001 — a torn sidecar must
+                # not kill the resume; dropping a residual only costs the
+                # one round's banked encode error
+                print(
+                    f"[coordinator] process {rt.process_id} codec residual "
+                    f"sidecar unreadable ({type(e).__name__}: {e}); "
+                    "starting the residual from zero"
+                )
 
     server_optimizer = None
     if msgpack_snapshots:
@@ -587,6 +628,15 @@ def main(argv: list[str] | None = None) -> int:
                     atomic_write_bytes(
                         snapshot_dir / "server_opt_state.msgpack",
                         server_optimizer.state_bytes(round_idx),
+                    )
+                if codec_snap is not None:
+                    # per-process EF residual rides the save cadence next
+                    # to the local state it pairs with
+                    from fedrec_tpu.comms import codec_state_bytes
+
+                    atomic_write_bytes(
+                        codec_snap,
+                        codec_state_bytes(rt.codec_state, round_idx),
                     )
                 if rt.is_server and rt.num_processes > 1:
                     # a degraded-mode respawn (single process) is a CLIENT

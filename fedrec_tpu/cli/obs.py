@@ -231,6 +231,19 @@ def _cmd_replay(args) -> int:
         if strategy.sync_params_every_round
         else None
     )
+    from fedrec_tpu.train.step import compressed_sync_active
+
+    # codec syncs (fed.dcn_compress != none) compress ROUND DELTAS: track
+    # each round's entry params so a chunk-spanning dump replays the exact
+    # compressed trajectory. Host copies — the step donates state buffers.
+    sync_takes_entry = sync is not None and compressed_sync_active(cfg, strategy)
+
+    def _entry_copy(st):
+        return jax.tree_util.tree_map(
+            np.asarray, (st.user_params, st.news_params)
+        )
+
+    entry = _entry_copy(state) if sync_takes_entry else None
     weights = {int(k): np.asarray(v) for k, v in manifest.get("weights", {}).items()}
 
     records = sorted(manifest["records"], key=lambda r: (r["round"], r["step"]))
@@ -244,7 +257,12 @@ def _cmd_replay(args) -> int:
             if sync is not None and prev_round in weights:
                 # re-apply the recorded round-end participation sync so a
                 # chunk-spanning dump replays the exact trajectory
-                state = sync(state, np.asarray(weights[prev_round]))
+                if sync_takes_entry:
+                    state = sync(state, np.asarray(weights[prev_round]), *entry)
+                else:
+                    state = sync(state, np.asarray(weights[prev_round]))
+            if sync_takes_entry:
+                entry = _entry_copy(state)
             prev_round = rec["round"]
         try:
             batch = dict(np.load(flight_dir / rec["file"]))

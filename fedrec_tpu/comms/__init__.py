@@ -1,0 +1,44 @@
+"""Communication-efficient cross-host sync: the update-compression codec
+subsystem (``fed.dcn_compress``). See :mod:`fedrec_tpu.comms.codecs`."""
+
+from fedrec_tpu.comms.codecs import (
+    CODECS,
+    EF_CODECS,
+    CodecState,
+    EncodedTree,
+    codec_decodes_per_contribution,
+    codec_state_bytes,
+    codec_uses_feedback,
+    decode_gathered,
+    decode_leaf,
+    decode_tree,
+    encode_leaf,
+    encode_tree,
+    jax_encode_decode,
+    load_codec_state,
+    payload_nbytes,
+    topk_count,
+    tree_dense_nbytes,
+    validate_codec,
+)
+
+__all__ = [
+    "CODECS",
+    "EF_CODECS",
+    "CodecState",
+    "EncodedTree",
+    "codec_decodes_per_contribution",
+    "codec_state_bytes",
+    "codec_uses_feedback",
+    "decode_gathered",
+    "decode_leaf",
+    "decode_tree",
+    "encode_leaf",
+    "encode_tree",
+    "jax_encode_decode",
+    "load_codec_state",
+    "payload_nbytes",
+    "topk_count",
+    "tree_dense_nbytes",
+    "validate_codec",
+]

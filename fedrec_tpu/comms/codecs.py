@@ -1,0 +1,321 @@
+"""Update-compression codecs for the client→server sync.
+
+The reference ships the FULL ``state_dict`` — frozen DistilBERT trunk
+included — from every client every round over raw TCP (~268 MB/client/round,
+Final_Report.pdf §VII.b). PR 1–6 already cut that to the two trainable
+towers riding XLA collectives; this module is the next lever (ROADMAP open
+item 3): compress the per-round *update* itself. One codec registry serves
+both places an update crosses a wire (or a simulated one):
+
+* the **in-graph round-end sync** (``train.step._make_local_sync``): each
+  cohort client's round delta is encoded/decoded inside the jitted program
+  (the jax variants below), modeling the cross-device uplink — sign1bit and
+  topk carry **per-client error-feedback residuals** (a ``ClientState``
+  field, spilled/restored through the ``fed.population`` sidecar store) so
+  the mass a lossy codec drops re-enters the next round's update
+  (EF-signSGD, Karimireddy et al. 2019; the communication-perspective FL
+  survey, arXiv:2405.20431);
+* the **coordinator's cross-host DCN gather**
+  (``parallel.multihost.aggregate_from_hosts``): the numpy variants below
+  encode each process's contribution into REAL wire buffers (what
+  ``process_allgather`` actually ships), decode every contribution
+  per-process before any reduction — so Byzantine-robust aggregators judge
+  clients, not quantization noise (decode-before-reduce) — and the byte
+  counts published to the metrics registry are measured from those buffers,
+  not dtype arithmetic.
+
+The numpy and jax variants implement the SAME arithmetic (same scales, same
+round-half-to-even, same top-k tie-break: ties keep the lowest flat index),
+pinned against each other in ``tests/test_comms.py``, so a trajectory
+simulated in-graph matches what the wire codec would reconstruct.
+
+Codecs:
+
+``none``     — identity; the wire carries dense float32.
+``int8``     — symmetric per-tensor int8: ``x ≈ q * scale`` with
+               ``scale = max|x| / 127``; worst-case element error
+               ``scale/2``. ~4× the wire. No residual (rounding noise is
+               zero-mean and bounded).
+``sign1bit`` — 1 bit per element + one f32 scale per tensor:
+               ``x ≈ sign(x) * mean|x|`` (signSGD with majority-free
+               scale). ~32× the wire. Biased — REQUIRES error feedback for
+               convergence (``fed.dcn_error_feedback``).
+``topk``     — structured sparsification: keep the ``ceil(ratio * n)``
+               largest-|x| coordinates per tensor (index + value pairs).
+               ``ratio = fed.dcn_topk_ratio``; ~``1/(2*ratio)``× the wire.
+               Biased — requires error feedback.
+
+DP ordering contract: per-example clipping and noise happen inside the
+train step, *before* any encode ever sees the update — the codec compresses
+an already-privatized delta, so the ε-accounting is untouched (pinned in
+docs/DESIGN.md §5g).
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+CODECS = ("none", "int8", "sign1bit", "topk")
+# codecs whose reconstruction error is biased (sign flips / dropped mass):
+# these carry per-client error-feedback residuals when fed.dcn_error_feedback
+EF_CODECS = ("sign1bit", "topk")
+
+
+def validate_codec(name: str) -> str:
+    """Fail FAST on a bad codec name. Raised lazily inside a DCN collective,
+    a typo would be misread by the watchdog as a peer failure and silently
+    degrade every host to standalone training."""
+    if name not in CODECS:
+        raise ValueError(
+            f"unknown fed.dcn_compress codec {name!r}; expected one of "
+            f"{CODECS}"
+        )
+    return name
+
+
+def codec_uses_feedback(codec: str, error_feedback: bool = True) -> bool:
+    """True when this codec keeps per-client error-feedback residuals."""
+    return error_feedback and codec in EF_CODECS
+
+
+def codec_decodes_per_contribution(codec: str) -> bool:
+    """True when each contribution can be decoded to a dense tensor BEFORE
+    any reduction — the property that makes robust aggregation (trimmed
+    mean / median / clip) legal with this codec (decode-before-reduce).
+    Every registered codec has it; an aggregated sketch (e.g. a summed
+    count-sketch, or in-network aggregation à la the Smart-NIC offload)
+    would not, and is where the robust×compress fail-fast lives."""
+    validate_codec(codec)
+    return True
+
+
+def topk_count(size: int, ratio: float) -> int:
+    """Coordinates kept per tensor under ``topk``: ``ceil(ratio * size)``,
+    at least 1, at most the tensor size."""
+    if not 0.0 < ratio <= 1.0:
+        raise ValueError(
+            f"fed.dcn_topk_ratio must be in (0, 1], got {ratio}"
+        )
+    return max(1, min(int(size), int(np.ceil(ratio * float(size)))))
+
+
+# ------------------------------------------------------------ numpy (wire)
+def encode_leaf(x: np.ndarray, codec: str, topk_ratio: float = 0.01) -> dict:
+    """One tensor → its wire payload: a flat dict of numpy arrays (a valid
+    pytree, so payloads travel through ``process_allgather`` unchanged).
+    The payload is everything that crosses the wire; shapes/dtypes are
+    host-side metadata both ends already hold (the model config)."""
+    x = np.asarray(x, np.float32)
+    if codec == "none":
+        return {"dense": x}
+    if codec == "int8":
+        amax = float(np.max(np.abs(x))) if x.size else 0.0
+        scale = np.float32(amax / 127.0)
+        if scale == 0.0:
+            q = np.zeros(x.shape, np.int8)
+        else:
+            q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+        return {"q": q, "scale": np.float32(scale)}
+    if codec == "sign1bit":
+        scale = np.float32(np.mean(np.abs(x))) if x.size else np.float32(0.0)
+        bits = np.packbits((x >= 0).reshape(-1))
+        return {"bits": bits, "scale": scale}
+    if codec == "topk":
+        flat = x.reshape(-1)
+        k = topk_count(flat.size, topk_ratio)
+        # descending |x|, ties broken by LOWEST flat index (stable sort on
+        # the negated magnitudes) — the same tie-break as lax.top_k, so the
+        # in-graph simulation and the wire codec keep identical coordinates
+        idx = np.argsort(-np.abs(flat), kind="stable")[:k].astype(np.int32)
+        return {"idx": idx, "val": flat[idx].astype(np.float32)}
+    raise ValueError(f"unknown codec {codec!r}")  # pragma: no cover
+
+
+def decode_leaf(payload: dict, codec: str, shape: tuple) -> np.ndarray:
+    """Wire payload → dense float32 tensor of ``shape``."""
+    if codec == "none":
+        return np.asarray(payload["dense"], np.float32).reshape(shape)
+    if codec == "int8":
+        return payload["q"].astype(np.float32) * np.float32(payload["scale"])
+    if codec == "sign1bit":
+        n = int(np.prod(shape)) if shape else 1
+        scale = np.float32(payload["scale"])
+        b = np.unpackbits(np.asarray(payload["bits"], np.uint8))[:n]
+        return np.where(b > 0, scale, -scale).astype(np.float32).reshape(shape)
+    if codec == "topk":
+        n = int(np.prod(shape)) if shape else 1
+        out = np.zeros((n,), np.float32)
+        out[np.asarray(payload["idx"], np.int64)] = np.asarray(
+            payload["val"], np.float32
+        )
+        return out.reshape(shape)
+    raise ValueError(f"unknown codec {codec!r}")  # pragma: no cover
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Measured wire bytes of one leaf's payload — real buffer sizes, not
+    dtype arithmetic."""
+    return int(sum(np.asarray(v).nbytes for v in payload.values()))
+
+
+@dataclass
+class EncodedTree:
+    """One contribution, encoded: the wire pytree plus the host-side
+    metadata needed to decode any process's copy of it."""
+
+    codec: str
+    payloads: list          # per-leaf payload dicts — the wire pytree
+    shapes: list            # per-leaf dense shapes (host metadata)
+    treedef: Any
+
+    def nbytes(self) -> int:
+        return int(sum(payload_nbytes(p) for p in self.payloads))
+
+
+def encode_tree(tree: Any, codec: str, topk_ratio: float = 0.01) -> EncodedTree:
+    import jax
+
+    validate_codec(codec)
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    flat = [np.asarray(x, np.float32) for x in flat]
+    return EncodedTree(
+        codec=codec,
+        payloads=[encode_leaf(x, codec, topk_ratio) for x in flat],
+        shapes=[x.shape for x in flat],
+        treedef=treedef,
+    )
+
+
+def decode_tree(enc: EncodedTree) -> Any:
+    import jax
+
+    leaves = [
+        decode_leaf(p, enc.codec, s) for p, s in zip(enc.payloads, enc.shapes)
+    ]
+    return jax.tree_util.tree_unflatten(enc.treedef, leaves)
+
+
+def decode_gathered(gathered_payloads: list, enc: EncodedTree) -> Any:
+    """Decode an allgathered copy of ``enc``'s wire pytree — every payload
+    array carries a leading (P,) process dim — into a tree whose leaves are
+    dense ``(P, *shape)`` float32 stacks: exactly what
+    ``robust_reduce_tree_np`` (or a weighted mean) consumes. THE
+    decode-before-reduce step: each contribution is densified per process
+    before any cross-process reduction sees it."""
+    import jax
+
+    leaves = []
+    for payload, shape in zip(gathered_payloads, enc.shapes):
+        num_p = int(np.asarray(next(iter(payload.values()))).shape[0])
+        rows = [
+            decode_leaf(
+                {k: np.asarray(v)[p] for k, v in payload.items()},
+                enc.codec,
+                shape,
+            )
+            for p in range(num_p)
+        ]
+        leaves.append(np.stack(rows))
+    return jax.tree_util.tree_unflatten(enc.treedef, leaves)
+
+
+def tree_dense_nbytes(tree: Any) -> int:
+    """Bytes the same contribution would cost uncompressed (dense f32)."""
+    import jax
+
+    return int(
+        sum(4 * np.asarray(x).size for x in jax.tree_util.tree_leaves(tree))
+    )
+
+
+# ----------------------------------------------------- jax (in-graph twin)
+def jax_encode_decode(x, codec: str, topk_ratio: float = 0.01):
+    """Encode→decode one tensor INSIDE a jitted program: the arithmetic
+    twin of ``decode_leaf(encode_leaf(x))``, expressed in jnp so the
+    round-end sync can compress per-client updates without leaving the
+    compiled round. Same scales, same round-half-to-even, same top-k
+    tie-break as the numpy wire codec (pinned in tests/test_comms.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    xf = jnp.asarray(x, jnp.float32)
+    if codec == "none":
+        return xf
+    if codec == "int8":
+        amax = jnp.max(jnp.abs(xf))
+        scale = amax / 127.0
+        q = jnp.clip(
+            jnp.round(xf / jnp.where(scale > 0, scale, 1.0)), -127, 127
+        ).astype(jnp.int8)
+        return q.astype(jnp.float32) * scale
+    if codec == "sign1bit":
+        scale = jnp.mean(jnp.abs(xf))
+        return jnp.where(xf >= 0, scale, -scale)
+    if codec == "topk":
+        flat = xf.reshape(-1)
+        k = topk_count(flat.shape[0], topk_ratio)
+        # lax.top_k on |x|: descending, ties keep the lowest index — the
+        # numpy codec's stable argsort reproduces this exactly
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        out = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        return out.reshape(xf.shape)
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+# -------------------------------------------------- host residual sidecar
+@dataclass
+class CodecState:
+    """Host-side error-feedback state for ONE wire endpoint (a coordinator
+    process). The in-graph per-client residuals live in ``ClientState``
+    instead (``ef_residual``, a ``fed.population`` sidecar field); this is
+    the cross-host DCN gather's single per-process residual."""
+
+    residual: Any = None    # pytree matching the contribution, or None
+
+    def residual_nbytes(self) -> int:
+        return 0 if self.residual is None else tree_dense_nbytes(self.residual)
+
+
+def codec_state_bytes(state: CodecState, round_idx: int) -> bytes:
+    """Serialize a process residual for the coordinator's save cadence."""
+    import jax
+
+    buf = io.BytesIO()
+    leaves = (
+        []
+        if state.residual is None
+        else [np.asarray(x) for x in jax.tree_util.tree_leaves(state.residual)]
+    )
+    np.savez(
+        buf,
+        round=np.int64(round_idx),
+        count=np.int64(len(leaves)),
+        **{f"leaf_{i}": x for i, x in enumerate(leaves)},
+    )
+    return buf.getvalue()
+
+
+def load_codec_state(blob: bytes, template_tree: Any) -> tuple[CodecState, int]:
+    """Restore a process residual serialized by :func:`codec_state_bytes`.
+    ``template_tree`` supplies the pytree structure (the contribution tree);
+    a zero-leaf blob restores ``residual=None``."""
+    import jax
+
+    with np.load(io.BytesIO(blob)) as z:
+        round_idx = int(z["round"])
+        count = int(z["count"])
+        if count == 0:
+            return CodecState(residual=None), round_idx
+        leaves = [z[f"leaf_{i}"] for i in range(count)]
+    treedef = jax.tree_util.tree_structure(template_tree)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"residual sidecar holds {len(leaves)} leaves but the "
+            f"contribution tree has {treedef.num_leaves} — config changed "
+            "since the sidecar was written?"
+        )
+    return CodecState(residual=jax.tree_util.tree_unflatten(treedef, leaves)), round_idx

@@ -268,10 +268,30 @@ class FedConfig:
     server_opt: str = "none"           # "none" | "sgd" | "adam"
     server_lr: float = 1.0
     server_momentum: float = 0.9
-    # coordinator-deployment client->server payload compression over DCN:
-    # "int8" = symmetric per-tensor quantization (4x the wire, zero-mean
-    # rounding noise on the round mean; fan-out stays full precision)
-    dcn_compress: str = "none"         # "none" | "int8"
+    # client->server UPDATE compression (fedrec_tpu.comms): applied at the
+    # in-graph round-end sync (each cohort client's round delta — the
+    # simulated cross-device uplink, host-driven AND rounds-in-jit) and at
+    # the coordinator's cross-host DCN gather (real wire buffers). The
+    # server->client fan-out stays full precision in every mode.
+    #   "none"     — dense f32 (bit-identical to the pre-codec sync)
+    #   "int8"     — symmetric per-tensor int8 deltas (~4x the wire)
+    #   "sign1bit" — 1 bit/coord + per-tensor scale (~32x); needs EF
+    #   "topk"     — keep the dcn_topk_ratio largest coords (~1/(2*ratio)x);
+    #                needs EF
+    # Every codec decodes per contribution BEFORE any reduction, so robust
+    # aggregation (fed.robust.method) composes with all of them
+    # (decode-before-reduce — trimmed mean judges clients, not
+    # quantization noise).
+    dcn_compress: str = "none"         # "none" | "int8" | "sign1bit" | "topk"
+    # topk: fraction of coordinates kept per tensor (ceil(ratio * n), >= 1)
+    dcn_topk_ratio: float = 0.01
+    # per-client error-feedback residuals for the biased codecs
+    # (sign1bit/topk): the mass a lossy encode drops is carried in
+    # ClientState.ef_residual (a fed.population sidecar field — LRU/spill,
+    # checkpointed, reset on quarantine heal) and re-enters the next
+    # round's update. Disable only for ablations: biased codecs without EF
+    # are known not to converge (EF-signSGD, Karimireddy et al. 2019).
+    dcn_error_feedback: bool = True
     # Byzantine-robust aggregation + quarantine/rollback recovery (see
     # RobustConfig). Applies wherever params aggregate: the in-graph
     # round-end sync (param_avg, host-driven AND rounds-in-jit) and the

@@ -56,7 +56,14 @@ from typing import Any, Iterator
 
 import numpy as np
 
-SIDECAR_FIELDS = ("step", "opt_user", "opt_news", "rng", "news_grad_accum")
+# the non-parameter ClientState slot leaves that follow a LOGICAL client
+# across selections: optax states, PRNG key, step counter, decoupled-mode
+# grad accumulator, and the update codec's error-feedback residual
+# (fed.dcn_compress sign1bit/topk — a healed or fresh client starts from
+# the all-zero template residual, same contract as the optimizer moments)
+SIDECAR_FIELDS = (
+    "step", "opt_user", "opt_news", "rng", "news_grad_accum", "ef_residual",
+)
 
 
 class QuorumFailure(Exception):

@@ -326,6 +326,40 @@ def build_report(
                     part[key] = v
             report["participation"] = part
 
+        # ---- communication: measured wire traffic — per-path byte
+        # counters ("cohort" = the simulated in-graph client uplink,
+        # counted only under an active codec; "dcn" = the coordinator's
+        # real cross-host gather, counted in EVERY mode, dense bytes
+        # included) plus the per-client compression ratio. Keyed on any
+        # up-bytes having been counted: single-process runs without a
+        # codec stay silent, a multi-process run always shows its DCN
+        # bytes.
+        up_by_path = {
+            row["labels"].get("path", "?"): row["value"]
+            for row in _metric_values(last, "fed.dcn_bytes_up_total")
+            if "value" in row and row["value"] > 0
+        }
+        if up_by_path:
+            comm: dict[str, Any] = {
+                "bytes_up": up_by_path,
+                "bytes_up_total": sum(up_by_path.values()),
+            }
+            down_by_path = {
+                row["labels"].get("path", "?"): row["value"]
+                for row in _metric_values(last, "fed.dcn_bytes_down_total")
+                if "value" in row and row["value"] > 0
+            }
+            if down_by_path:
+                comm["bytes_down"] = down_by_path
+                comm["bytes_down_total"] = sum(down_by_path.values())
+            ratio = snapshot_value(last, "fed.dcn_compression_ratio")
+            if ratio:
+                comm["compression_ratio"] = ratio
+            misses = snapshot_value(last, "fed.dcn_deadline_misses_total")
+            if misses:
+                comm["deadline_misses"] = misses
+            report["communication"] = comm
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -468,6 +502,30 @@ def render_text(report: dict) -> str:
             f"quorum replays: {int(part.get('quorum_replays', 0))}, "
             f"slot swaps: {int(part.get('slot_swaps', 0))}"
         )
+        lines.append("")
+    comm = report.get("communication")
+    if comm:
+        lines.append("## Communication")
+
+        def _mb(n: float) -> str:
+            return f"{n / (1024 * 1024):.2f} MB"
+
+        up = ", ".join(
+            f"{p}={_mb(v)}" for p, v in sorted(comm["bytes_up"].items())
+        )
+        lines.append(f"client->server bytes: {up}")
+        if "bytes_down" in comm:
+            down = ", ".join(
+                f"{p}={_mb(v)}" for p, v in sorted(comm["bytes_down"].items())
+            )
+            lines.append(f"server->client bytes: {down} (full precision)")
+        if "compression_ratio" in comm:
+            lines.append(
+                f"update compression: {comm['compression_ratio']:.1f}x "
+                "(dense/encoded, per client-round payload)"
+            )
+        if "deadline_misses" in comm:
+            lines.append(f"dcn deadline misses: {int(comm['deadline_misses'])}")
         lines.append("")
     if "cap_overflow_steps" in report:
         lines.append(f"cap-overflow steps: {int(report['cap_overflow_steps'])}")

@@ -129,37 +129,58 @@ def broadcast_round_index(round_idx: int) -> int:
 
 
 def validate_compress(compress: str) -> str:
-    """Fail FAST on a bad mode: raised lazily inside the aggregation
+    """Fail FAST on a bad codec name (delegates to the
+    :mod:`fedrec_tpu.comms` registry): raised lazily inside the aggregation
     collective, a typo would be misread by the watchdog as a peer failure
     and silently degrade every host to standalone training."""
-    if compress not in ("none", "int8"):
-        raise ValueError(f"unknown compress mode {compress!r}; 'none' | 'int8'")
-    return compress
+    from fedrec_tpu.comms import validate_codec
+
+    return validate_codec(compress)
 
 
-def quantize_leaf(p: Any) -> tuple[np.ndarray, np.float32]:
-    """Symmetric per-tensor int8 quantization: ``p ~= q * scale``.
+def _bank_dcn_bytes(
+    up: int = 0, down: int = 0, dense: int = 0, encoded: int = 0
+) -> None:
+    """Publish REAL cross-host wire bytes into the metrics registry
+    (path="dcn" — the Trainer's simulated in-graph uplink uses
+    path="cohort"). Bytes are measured from the encoded buffers the
+    collective actually ships, not dtype arithmetic."""
+    from fedrec_tpu.obs import get_registry
 
-    Max-abs scaling to 127 levels; an all-zero tensor gets scale 0 (its
-    dequantization is exactly zero). Worst-case element error is scale/2 =
-    max|p|/254 — ~0.2% of the tensor's dynamic range.
-    """
-    p = np.asarray(p, np.float32)
-    amax = float(np.max(np.abs(p))) if p.size else 0.0
-    scale = np.float32(amax / 127.0)
-    if scale == 0.0:
-        return np.zeros(p.shape, np.int8), scale
-    q = np.clip(np.rint(p / scale), -127, 127).astype(np.int8)
-    return q, scale
+    reg = get_registry()
+    if up:
+        reg.counter(
+            "fed.dcn_bytes_up_total",
+            "client->server round-update bytes shipped, by path",
+            labels=("path",),
+        ).inc(float(up), path="dcn")
+    if down:
+        reg.counter(
+            "fed.dcn_bytes_down_total",
+            "server->client fan-out bytes (full precision), by path",
+            labels=("path",),
+        ).inc(float(down), path="dcn")
+    if dense and encoded:
+        reg.gauge(
+            "fed.dcn_compression_ratio",
+            "dense/encoded byte ratio of one client's round-update payload",
+        ).set(dense / encoded)
 
 
-def dequantize_weighted_mean(
-    gathered_q: np.ndarray, gathered_scales: np.ndarray, weights: np.ndarray
-) -> np.ndarray:
-    """(P, ...) int8 contributions + (P,) scales + (P,) weights -> weighted
-    mean ``sum_i w_i * q_i * s_i / sum_i w_i`` (caller guards total > 0)."""
-    coeff = (weights * gathered_scales / np.sum(weights)).astype(np.float32)
-    return np.einsum("p,p...->...", coeff, gathered_q.astype(np.float32))
+def _allgather_stacked(tree_and_weight: tuple) -> tuple:
+    """``process_allgather`` with the leading (P,) process dim GUARANTEED:
+    in a single-process world the gather is an identity (no stacking), so
+    the P=1 case — exercised directly by unit tests, and by a degraded
+    host finishing standalone — gets the dim added by hand. Weights come
+    back as a (P,) float32 vector either way."""
+    gathered, weights = multihost_utils.process_allgather(tree_and_weight)
+    w = np.asarray(weights, np.float32)
+    if w.ndim == 0:
+        gathered = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[None], gathered
+        )
+        w = w[None]
+    return gathered, w
 
 
 def aggregate_from_hosts(
@@ -168,6 +189,9 @@ def aggregate_from_hosts(
     compress: str = "none",
     base: Any = None,
     robust: Any = None,
+    codec_state: Any = None,
+    topk_ratio: float = 0.01,
+    error_feedback: bool = True,
 ) -> Any:
     """Participation-weighted FedAvg across processes.
 
@@ -176,51 +200,145 @@ def aggregate_from_hosts(
     aggregate — the allgather-based replacement for the server's
     TCP-gather + key-wise mean (``server.py:37-55,102``).
 
-    ``compress='int8'`` quantizes the client->server payload (symmetric
-    per-tensor int8 + one f32 scale), cutting the gather traffic 4x on top
-    of the trainable-towers-only design. The server->client fan-out
-    (:func:`broadcast_params`) stays full precision — quantizing the global
-    model would bias every client's training, while quantizing the per-round
-    CONTRIBUTIONS only adds zero-mean rounding noise to the mean.
+    ``compress`` selects an update codec from :mod:`fedrec_tpu.comms`
+    (``int8`` | ``sign1bit`` | ``topk``): the client->server payload is the
+    ENCODED contribution — real int8/bit-packed/index+value buffers through
+    ``process_allgather`` — while the server->client fan-out
+    (:func:`broadcast_params`) stays full precision (quantizing the global
+    model would bias every client's training; compressing only the
+    per-round CONTRIBUTIONS adds bounded reconstruction error to the mean,
+    and the biased codecs bank that error per process via ``codec_state``).
 
-    ``robust`` (a ``fed.robust`` config section with ``method != "mean"``)
-    swaps the weighted mean for a Byzantine-robust reduction
-    (:func:`fedrec_tpu.fed.robust.robust_reduce_tree_np`) applied to the
-    (P, ...) stacks ``process_allgather`` already materializes — the
-    cross-HOST counterpart of the in-graph cohort aggregators, so a
-    poisoned *process* cannot move the coordinator's global either.
-    Robust methods require ``compress='none'``: trimming per coordinate
-    after int8 rounding would judge quantization noise, not clients.
+    DECODE-BEFORE-REDUCE: every gathered contribution is densified
+    per-process before ANY reduction, so ``robust`` (a ``fed.robust``
+    section with ``method != "mean"``) composes with every codec —
+    trimmed-mean/median judge clients, not quantization noise. The only
+    remaining fail-fast is a codec that cannot decode per contribution
+    (an aggregated sketch; none registered —
+    :func:`fedrec_tpu.comms.codec_decodes_per_contribution`).
 
-    ``base`` (int8 mode only): a pytree every process holds identically —
-    the round-start global from the server fan-out. When given, the round
-    DELTAS ``params - base`` are quantized instead of the absolute tensors
+    ``base``: a pytree every process holds identically — the round-start
+    global from the server fan-out. With a codec active the round DELTAS
+    ``params - base`` are encoded instead of the absolute tensors
     (ADVICE r2): one round's delta spans a far smaller range than the
-    parameters, so the same 127 levels bound the per-element error by
-    ``max|delta|/254`` instead of ``max|param|/254`` — and a single outlier
-    WEIGHT no longer degrades the whole tensor's resolution, only an
-    outlier single-round UPDATE would. The weighted mean commutes with the
-    shift: ``mean_w(params) == base + mean_w(params - base)`` exactly.
+    parameters, so the codec's levels bound the per-element error by the
+    DELTA's range. The weighted mean commutes with the shift:
+    ``mean_w(params) == base + mean_w(params - base)``.
+
+    ``codec_state`` (:class:`fedrec_tpu.comms.CodecState`): this process's
+    error-feedback residual for the biased codecs (sign1bit/topk with
+    ``error_feedback``) — the mass the encode drops is added to the NEXT
+    round's contribution. Updated in place; only when this process
+    participates (``weight > 0``; a sit-out transmitted nothing).
+
+    DP ordering: clipping + noise happened per step inside training, so
+    the delta this function encodes is already privatized — encode runs
+    strictly AFTER the mechanism, ε-accounting untouched.
     """
     validate_compress(compress)
     w_arr = np.asarray(weight, np.float32)
     method = getattr(robust, "method", "mean") if robust is not None else "mean"
     if method != "mean":
-        from fedrec_tpu.fed.robust import (
-            robust_reduce_tree_np,
-            validate_robust_method,
-        )
+        from fedrec_tpu.fed.robust import validate_robust_method
 
         validate_robust_method(method)
         if compress != "none":
-            raise ValueError(
-                f"fed.robust.method={method!r} requires "
-                "fed.dcn_compress='none': coordinate-wise robust reduction "
-                "over int8-quantized contributions would trim quantization "
-                "noise, not clients"
+            from fedrec_tpu.comms import codec_decodes_per_contribution
+
+            if not codec_decodes_per_contribution(compress):
+                raise ValueError(
+                    f"fed.robust.method={method!r} needs per-contribution "
+                    f"decode, which codec {compress!r} cannot provide (its "
+                    "contributions only exist pre-aggregated); use one of "
+                    "the decodable codecs (int8/sign1bit/topk) or "
+                    "fed.robust.method='mean'"
+                )
+
+    if compress != "none":
+        from fedrec_tpu.comms import (
+            codec_uses_feedback,
+            decode_gathered,
+            decode_tree,
+            encode_tree,
+            tree_dense_nbytes,
+        )
+        from fedrec_tpu.fed.robust import robust_reduce_tree_np
+
+        raw = jax.tree_util.tree_map(
+            lambda p: np.asarray(p, np.float32), params
+        )
+        if base is not None:
+            contrib = jax.tree_util.tree_map(
+                lambda p, b: p - np.asarray(b, np.float32), raw, base
             )
+        else:
+            contrib = raw
+        use_ef = codec_uses_feedback(compress, error_feedback)
+        if use_ef and codec_state is not None and codec_state.residual is not None:
+            acc = jax.tree_util.tree_map(
+                lambda c, r: c + np.asarray(r, np.float32),
+                contrib, codec_state.residual,
+            )
+        else:
+            acc = contrib
+        enc = encode_tree(acc, compress, topk_ratio)
+        own_decoded = decode_tree(enc)
+        if use_ef and codec_state is not None and float(w_arr) > 0:
+            codec_state.residual = jax.tree_util.tree_map(
+                lambda a, d: a - d, acc, own_decoded
+            )
+        # ONE collective for payload + weight: fewer DCN round trips, and
+        # no window where a peer death strands the runtime between
+        # matched gathers
+        gathered, weights = _allgather_stacked((enc.payloads, w_arr))
+        _bank_dcn_bytes(
+            up=enc.nbytes(),
+            dense=tree_dense_nbytes(acc),
+            encoded=enc.nbytes(),
+        )
+        total = float(np.sum(weights))
+        if total == 0.0:
+            return params  # nobody reported; keep local (no NaNs)
+        stacks = decode_gathered(gathered, enc)  # leaves: (P, *shape) dense
+        w_np = np.asarray(weights)
+        if method != "mean":
+            reduced = robust_reduce_tree_np(
+                stacks, w_np, method,
+                trim_k=robust.trim_k, clip_norm=robust.clip_norm,
+                # m==0 coordinates keep this host's own decoded
+                # contribution (the in-graph fallback contract)
+                fallback_tree=own_decoded,
+            )
+        else:
+            coeff = (np.where(w_np > 0, w_np, 0.0) / total).astype(np.float32)
+
+            def _masked_mean(s):
+                # zero-WEIGHT contributions are masked out of the sum, not
+                # multiplied in: a quarantined process's NaN decode must
+                # contribute nothing, not NaN (weighted_param_avg parity)
+                mask = (w_np > 0).reshape((-1,) + (1,) * (s.ndim - 1))
+                return np.einsum(
+                    "p,p...->...", coeff, np.where(mask, s, 0.0)
+                )
+
+            reduced = jax.tree_util.tree_map(_masked_mean, stacks)
+        if base is not None:
+            reduced = jax.tree_util.tree_map(
+                lambda m, b: m + np.asarray(b, np.float32), reduced, base
+            )
+        return jax.tree_util.tree_map(
+            lambda m, p: jnp.asarray(np.asarray(m, np.asarray(p).dtype)),
+            reduced, params,
+        )
+
+    if method != "mean":
+        from fedrec_tpu.fed.robust import robust_reduce_tree_np
+
         raw = jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32), params)
-        gathered, weights = multihost_utils.process_allgather((raw, w_arr))
+        gathered, weights = _allgather_stacked((raw, w_arr))
+        from fedrec_tpu.comms import tree_dense_nbytes
+
+        _bank_dcn_bytes(up=tree_dense_nbytes(raw))
         if float(np.sum(weights)) == 0.0:
             return params  # nobody reported; keep local (no NaNs)
         reduced = robust_reduce_tree_np(
@@ -232,41 +350,11 @@ def aggregate_from_hosts(
             lambda m, p: jnp.asarray(np.asarray(m, np.asarray(p).dtype)),
             reduced, params,
         )
-    if compress == "int8":
-        flat, treedef = jax.tree_util.tree_flatten(params)
-        if base is not None:
-            base_flat = jax.tree_util.tree_leaves(base)
-            flat = [
-                np.asarray(p, np.float32) - np.asarray(b, np.float32)
-                for p, b in zip(flat, base_flat)
-            ]
-        pairs = [quantize_leaf(p) for p in flat]
-        q = jax.tree_util.tree_unflatten(treedef, [x[0] for x in pairs])
-        scales = jax.tree_util.tree_unflatten(treedef, [x[1] for x in pairs])
-        # ONE collective for payload + scales + weight: fewer DCN round
-        # trips, and no window where a peer death strands the runtime
-        # between matched gathers
-        gathered_q, gathered_s, weights = multihost_utils.process_allgather(
-            (q, scales, w_arr)
-        )
-        total = float(np.sum(weights))
-        if total == 0.0:
-            return params  # nobody reported; keep local (no NaNs)
-        mean = jax.tree_util.tree_map(
-            lambda gq, gs: dequantize_weighted_mean(
-                np.asarray(gq), np.asarray(gs), np.asarray(weights)
-            ),
-            gathered_q,
-            gathered_s,
-        )
-        if base is not None:
-            return jax.tree_util.tree_map(
-                lambda m, b: jnp.asarray(m + np.asarray(b, np.float32)),
-                mean, base,
-            )
-        return jax.tree_util.tree_map(jnp.asarray, mean)
     weighted = jax.tree_util.tree_map(lambda p: np.asarray(p) * weight, params)
-    gathered, weights = multihost_utils.process_allgather((weighted, w_arr))
+    from fedrec_tpu.comms import tree_dense_nbytes
+
+    _bank_dcn_bytes(up=tree_dense_nbytes(weighted))
+    gathered, weights = _allgather_stacked((weighted, w_arr))
     total = float(np.sum(weights))
     if total == 0.0:
         return params  # nobody reported; keep local (no NaNs)
@@ -308,6 +396,8 @@ class CoordinatorRuntime:
         compress: str = "none",
         robust: Any = None,
         round_deadline_s: float | None = None,
+        topk_ratio: float = 0.01,
+        error_feedback: bool = True,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
@@ -323,6 +413,20 @@ class CoordinatorRuntime:
         self.degraded_by_timeout = False
         self.compress = validate_compress(compress)
         self.robust = robust  # fed.robust section; None/mean = plain FedAvg
+        self.topk_ratio = topk_ratio
+        self.error_feedback = error_feedback
+        # this process's error-feedback residual for the biased codecs
+        # (sign1bit/topk): the wire endpoint's EF state, persisted by the
+        # coordinator CLI at save cadence so a resumed run keeps carrying
+        # the dropped mass (a fresh/restarted process starts from zero —
+        # the same bounded-staleness contract as a fresh logical client)
+        from fedrec_tpu.comms import CodecState, codec_uses_feedback
+
+        self.codec_state = (
+            CodecState()
+            if codec_uses_feedback(self.compress, error_feedback)
+            else None
+        )
         self.degraded = False
         self._shutdown_done = False
         if self.num_processes > 1:
@@ -397,10 +501,17 @@ class CoordinatorRuntime:
     def sync_from_server(self, params: Any) -> Any:
         if self.num_processes == 1:
             return params
-        return self._collective(
+        out = self._collective(
             lambda: broadcast_params(params, is_source=self.is_server),
             lambda: params,
         )
+        if not self.degraded:
+            from fedrec_tpu.comms import tree_dense_nbytes
+
+            # the fan-out is full precision in every codec mode (pinned:
+            # compressing the GLOBAL would bias every client's training)
+            _bank_dcn_bytes(down=tree_dense_nbytes(params))
+        return out
 
     def aggregate(
         self, params: Any, participated: bool = True, weight: float = 1.0,
@@ -426,7 +537,9 @@ class CoordinatorRuntime:
         out = self._collective(
             lambda: aggregate_from_hosts(
                 params, w, compress=self.compress, base=base,
-                robust=self.robust,
+                robust=self.robust, codec_state=self.codec_state,
+                topk_ratio=self.topk_ratio,
+                error_feedback=self.error_feedback,
             ),
             lambda: params,
             timeout_s=deadline if deadline else None,

@@ -34,6 +34,16 @@ class ClientState:
     opt_news: Any                     # optax state for news_params
     rng: jax.Array                    # per-client PRNG key
     news_grad_accum: jnp.ndarray      # (N_news, D) embedding-grad scatter target
+    # per-client error-feedback residual for the biased update codecs
+    # (fed.dcn_compress = sign1bit/topk with fed.dcn_error_feedback): a
+    # (user_params, news_params)-shaped pytree holding the mass the last
+    # lossy encode dropped, re-entering the next round's update. A scalar
+    # zero placeholder when the active codec keeps no residual — the state
+    # template (and so snapshots and the population sidecar) stay one
+    # structure per config. Listed in fed.population.SIDECAR_FIELDS, so it
+    # LRU/disk-spills with the optimizer moments and resets on quarantine
+    # heal (a healed client must not replay a poisoned residual).
+    ef_residual: Any = None
 
     def full_params(self) -> dict:
         """Reassemble the flax variables dict for ``model.apply``."""
@@ -102,6 +112,15 @@ def init_client_state(
     else:
         news_params = variables["params"]["text_head"]
     opt_user_tx, opt_news_tx = make_optimizers(cfg)
+    from fedrec_tpu.comms import codec_uses_feedback
+
+    if codec_uses_feedback(cfg.fed.dcn_compress, cfg.fed.dcn_error_feedback):
+        ef_residual = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32),
+            (user_params, news_params),
+        )
+    else:
+        ef_residual = jnp.zeros((), jnp.float32)
     return ClientState(
         step=jnp.zeros((), jnp.int32),
         user_params=user_params,
@@ -110,6 +129,7 @@ def init_client_state(
         opt_news=opt_news_tx.init(news_params),
         rng=state_rng,
         news_grad_accum=jnp.zeros((num_news, cfg.model.news_dim), jnp.float32),
+        ef_residual=ef_residual,
     )
 
 

@@ -1054,7 +1054,8 @@ def build_fed_round_scan(
         model, cfg, strategy, mesh, mode, noise_fn
     )
     _, sync_axes = cohort_axes(cfg, mesh)
-    local_round_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust)
+    local_round_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust, cfg.fed)
+    codec_sync = compressed_sync_active(cfg, strategy)
 
     @partial(
         shard_map,
@@ -1074,8 +1075,18 @@ def build_fed_round_scan(
 
         def one_round(carry, xs):
             r_batches, w = xs
+            # the codec sync compresses each client's ROUND DELTA, so it
+            # needs the round-entry params — captured from the carry here,
+            # exactly the trees the Trainer captures host-side for the
+            # host-driven path
+            entry_u, entry_n = carry.user_params, carry.news_params
             st, ms = lax.scan(one_step, carry, r_batches)
-            st = _cohort_call(local_round_sync, k, 2, st, w)
+            if codec_sync:
+                st = _cohort_call(
+                    local_round_sync, k, 4, st, w, entry_u, entry_n
+                )
+            else:
+                st = _cohort_call(local_round_sync, k, 2, st, w)
             return st, ms
 
         return lax.scan(one_round, stacked_state, (batches, weights))
@@ -1159,8 +1170,20 @@ def build_news_update_step(
     return jax.jit(sharded_update, donate_argnums=(0,))
 
 
+def compressed_sync_active(cfg: ExperimentConfig, strategy: FedStrategy) -> bool:
+    """True when the round-end sync runs the update-codec body — which
+    takes the round-ENTRY params as extra arguments (deltas are what the
+    codec compresses). ``dcn_compress='none'`` keeps the pre-codec sync
+    program byte-for-byte (the bit-identity contract)."""
+    return (
+        getattr(cfg.fed, "dcn_compress", "none") != "none"
+        and strategy.sync_params_every_round
+    )
+
+
 def _make_local_sync(
-    strategy: FedStrategy, sync_axes: Any, robust: Any = None
+    strategy: FedStrategy, sync_axes: Any, robust: Any = None,
+    fed_cfg: Any = None,
 ) -> Callable:
     """THE round-end parameter-sync body — shared by ``build_param_sync``
     (host-driven rounds) and ``build_fed_round_scan`` (rounds-in-jit) so
@@ -1173,8 +1196,99 @@ def _make_local_sync(
     towers aggregate as ONE tree so the clip method's global norm spans
     the whole client update (``fedrec_tpu.fed.robust``). Strategies that
     never sync params (local/grad_avg) stay untouched.
+
+    ``fed_cfg`` (the ``fed`` config section) selects the update codec
+    (``dcn_compress``). With a codec active the body signature grows to
+    ``(state, w, entry_user, entry_news)`` — the client's round-ENTRY
+    params — and the sync becomes the compressed-uplink model
+    (``fedrec_tpu.comms``):
+
+      1. ``delta_c = params_c - entry_c`` (each client's round update —
+         DP clip+noise already happened per step, BEFORE any encode);
+      2. ``acc_c = delta_c + residual_c`` (error feedback, biased codecs);
+      3. ``decoded_c = decode(encode(acc_c))`` in-graph — the arithmetic
+         twin of the wire codec; ``residual_c' = acc_c - decoded_c`` for
+         participants (non-participants transmitted nothing and keep
+         their residual);
+      4. DECODE-BEFORE-REDUCE: the aggregator — weighted mean OR any
+         ``fed.robust`` method — runs over the decoded dense deltas, so
+         trimmed-mean/median judge clients, not quantization noise;
+      5. every client adopts ``entry + aggregate`` (entries are the common
+         post-sync global in any participating round); a round where no
+         client reports keeps local params, the ``weighted_param_avg``
+         contract.
     """
     method = getattr(robust, "method", "mean") if robust is not None else "mean"
+    codec = getattr(fed_cfg, "dcn_compress", "none") if fed_cfg is not None else "none"
+    if codec != "none" and strategy.sync_params_every_round:
+        from fedrec_tpu.comms import (
+            codec_uses_feedback,
+            jax_encode_decode,
+            validate_codec,
+        )
+        from fedrec_tpu.fed.strategies import weighted_param_avg
+
+        validate_codec(codec)
+        use_ef = codec_uses_feedback(codec, fed_cfg.dcn_error_feedback)
+        ratio = fed_cfg.dcn_topk_ratio
+        if method != "mean":
+            from fedrec_tpu.fed.robust import (
+                robust_aggregate,
+                validate_robust_method,
+            )
+
+            validate_robust_method(method)
+
+        def local_sync(state: ClientState, w: jnp.ndarray, entry_u, entry_n):
+            entry = (entry_u, entry_n)
+            theta = (state.user_params, state.news_params)
+            delta = jax.tree_util.tree_map(
+                lambda t, e: t.astype(jnp.float32) - e.astype(jnp.float32),
+                theta, entry,
+            )
+            if use_ef:
+                residual = state.ef_residual
+                acc = jax.tree_util.tree_map(
+                    lambda d, r: d + r, delta, residual
+                )
+            else:
+                acc = delta
+            decoded = jax.tree_util.tree_map(
+                lambda x: jax_encode_decode(x, codec, ratio), acc
+            )
+            new_residual = None
+            if use_ef:
+                # a weight-0 client transmitted nothing this round: its
+                # residual carries over unchanged (its delta is discarded
+                # with its participation, not banked)
+                new_residual = jax.tree_util.tree_map(
+                    lambda a, d, r: jnp.where(w > 0, a - d, r),
+                    acc, decoded, residual,
+                )
+            if method != "mean":
+                agg = robust_aggregate(
+                    decoded, w, sync_axes,
+                    method=method, trim_k=robust.trim_k,
+                    clip_norm=robust.clip_norm,
+                )
+            else:
+                agg = weighted_param_avg(decoded, w, sync_axes)
+            any_p = lax.psum(
+                (w > 0).astype(jnp.float32), axis_name=sync_axes
+            ) > 0
+            new_user, new_news = jax.tree_util.tree_map(
+                lambda e, a, t: jnp.where(
+                    any_p, (e.astype(jnp.float32) + a).astype(t.dtype), t
+                ),
+                entry, agg, theta,
+            )
+            kwargs: dict = {"user_params": new_user, "news_params": new_news}
+            if new_residual is not None:
+                kwargs["ef_residual"] = new_residual
+            return state.replace(**kwargs)
+
+        return local_sync
+
     if method != "mean" and strategy.sync_params_every_round:
         from fedrec_tpu.fed.robust import robust_aggregate, validate_robust_method
 
@@ -1216,7 +1330,26 @@ def build_param_sync(
     axis = cfg.fed.mesh_axis
     strategy = strategy or ParamAvg()
     k, sync_axes = cohort_axes(cfg, mesh)
-    local_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust)
+    local_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust, cfg.fed)
+
+    if compressed_sync_active(cfg, strategy):
+        # codec body: ``sync(state, weights, entry_user, entry_news)`` —
+        # the caller supplies the round-ENTRY param trees (stacked per
+        # client), captured before the round's first (buffer-donating)
+        # step dispatch
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        def sharded_sync_c(stacked_state, weights, entry_u, entry_n):
+            return _cohort_call(
+                local_sync, k, 4, stacked_state, weights, entry_u, entry_n
+            )
+
+        return jax.jit(sharded_sync_c)
 
     @partial(
         shard_map,

@@ -51,6 +51,7 @@ from fedrec_tpu.train.step import (
     build_news_update_step,
     build_fed_train_scan,
     build_param_sync,
+    compressed_sync_active,
     encode_all_news,
     encode_all_news_sharded,
     shard_round_batches,
@@ -139,6 +140,23 @@ class Trainer:
                     "fed.robust.recover=true requires obs.health.sentry: "
                     "recovery is driven by the in-graph health vectors"
                 )
+        # ---- update-compression codec (fed.dcn_compress, fedrec_tpu.comms):
+        # validated up front like robust/server_opt — a codec that would
+        # silently never run is a misconfiguration, not a preference
+        from fedrec_tpu.comms import validate_codec
+
+        validate_codec(cfg.fed.dcn_compress)
+        if (
+            cfg.fed.dcn_compress != "none"
+            and not self.strategy.sync_params_every_round
+        ):
+            raise ValueError(
+                f"fed.dcn_compress={cfg.fed.dcn_compress!r} requires a "
+                "strategy that syncs params every round (param_avg or "
+                f"coordinator); fed.strategy={cfg.fed.strategy!r} never "
+                "ships a round update, so the codec would silently never "
+                "run (per-step grad_avg traffic is not compressed)"
+            )
         self.chaos = None
         if cfg.chaos.enabled:
             from fedrec_tpu.fed.chaos import FaultPlan
@@ -363,6 +381,9 @@ class Trainer:
             self.model, cfg, self.mesh, self.strategy
         )
         self.param_sync = build_param_sync(cfg, self.mesh, self.strategy)
+        # codec syncs take the round-ENTRY params (the delta base) as extra
+        # args — captured per round before the first buffer-donating step
+        self._sync_takes_entry = compressed_sync_active(cfg, self.strategy)
         self.eval_step = build_eval_step(self.model, cfg)
         # full-pool eval sharded over the mesh when there is one: same
         # per-impression math, 1/mesh.size of the eval wall time (the
@@ -641,6 +662,50 @@ class Trainer:
             "fed.population_coverage",
             "fraction of the population selected at least once",
         )
+        # ---- communication instruments (fed.dcn_compress,
+        # fedrec_tpu.comms): byte counters labeled by path — "cohort" is
+        # the in-graph simulated client uplink (bytes measured from a real
+        # wire-codec encode of the param trees, not dtype arithmetic),
+        # "dcn" the coordinator's actual cross-host gather (counted in
+        # parallel.multihost). Registered always; zero-valued (and the
+        # report section silent) when no codec is active.
+        self._m_bytes_up = self.registry.counter(
+            "fed.dcn_bytes_up_total",
+            "client->server round-update bytes shipped, by path "
+            "(cohort = simulated in-graph uplink, dcn = real cross-host "
+            "gather)",
+            labels=("path",),
+        )
+        self._m_bytes_down = self.registry.counter(
+            "fed.dcn_bytes_down_total",
+            "server->client fan-out bytes (full precision in every mode), "
+            "by path",
+            labels=("path",),
+        )
+        self._g_comp_ratio = self.registry.gauge(
+            "fed.dcn_compression_ratio",
+            "dense/encoded byte ratio of one client's round-update payload "
+            "under the active codec",
+        )
+        self._codec_bytes_per_client: int | None = None
+        self._dense_bytes_per_client: int | None = None
+        if cfg.fed.dcn_compress != "none":
+            from fedrec_tpu.comms import encode_tree, tree_dense_nbytes
+
+            host_params = jax.tree_util.tree_map(
+                np.asarray, self._client0_params()
+            )
+            enc = encode_tree(
+                host_params, cfg.fed.dcn_compress, cfg.fed.dcn_topk_ratio
+            )
+            # payload sizes are static per (codec, shapes): one real encode
+            # prices every round's uplink exactly
+            self._codec_bytes_per_client = enc.nbytes()
+            self._dense_bytes_per_client = tree_dense_nbytes(host_params)
+            self._g_comp_ratio.set(
+                self._dense_bytes_per_client
+                / max(self._codec_bytes_per_client, 1)
+            )
         # spent-epsilon trajectory: one gauge per round, next to loss/AUC.
         # Only the rigorous mechanism gets a trajectory — ldp_news carries
         # no (epsilon, delta) statement to spend against (docs/DP.md).
@@ -1220,6 +1285,12 @@ class Trainer:
                 )
         if self._round_retries:
             args["replay_retry"] = self._round_retries
+        if self._codec_bytes_per_client is not None:
+            # byte attrs ride the fed_round span: what ONE client's update
+            # costs on the wire under the active codec, vs dense
+            args["codec"] = self.cfg.fed.dcn_compress
+            args["codec_bytes_per_client"] = self._codec_bytes_per_client
+            args["dense_bytes_per_client"] = self._dense_bytes_per_client
         return args
 
     def _tick_quarantine(self) -> None:
@@ -1270,6 +1341,9 @@ class Trainer:
                 opt_user=fix(host.opt_user, False),
                 opt_news=fix(host.opt_news, False),
                 news_grad_accum=fix(host.news_grad_accum, False),
+                # a healed client must not replay a poisoned codec
+                # residual — same contract as the optimizer moments
+                ef_residual=fix(host.ef_residual, False),
             )
         )
         print(
@@ -1634,6 +1708,44 @@ class Trainer:
             f"{pcfg.quorum_retries})"
         )
 
+    def _count_uplink(self, weights_np: np.ndarray) -> None:
+        """Bank one synced round's (or one chunk row's) modeled wire
+        traffic: each REPORTING client ships one encoded update up, every
+        client receives one dense fan-out down. Bytes come from a real
+        wire-codec encode of the param trees (init-time; payload sizes are
+        static per codec × shapes). No-op without an active codec."""
+        if self._codec_bytes_per_client is None:
+            return
+        w = np.asarray(weights_np).reshape(-1, self.cfg.fed.num_clients)
+        reporting = int((w > 0).sum())
+        rounds = int(w.shape[0])
+        self._m_bytes_up.inc(
+            float(self._codec_bytes_per_client * reporting), path="cohort"
+        )
+        self._m_bytes_down.inc(
+            float(
+                self._dense_bytes_per_client
+                * self.cfg.fed.num_clients
+                * rounds
+            ),
+            path="cohort",
+        )
+
+    def _uplink_span_args(self, weights_np: np.ndarray) -> dict:
+        """Byte attrs for the aggregate span under an active codec."""
+        if self._codec_bytes_per_client is None:
+            return {}
+        w = np.asarray(weights_np).reshape(-1, self.cfg.fed.num_clients)
+        return {
+            "codec": self.cfg.fed.dcn_compress,
+            "bytes_up": int(self._codec_bytes_per_client * (w > 0).sum()),
+            "bytes_down": int(
+                self._dense_bytes_per_client
+                * self.cfg.fed.num_clients
+                * w.shape[0]
+            ),
+        }
+
     def _chaos_batch_keys(self, round_idx: int) -> dict | None:
         """Per-client fault vectors every chaos-enabled batch must carry
         (``train.step`` applies them at the update boundary)."""
@@ -1670,6 +1782,15 @@ class Trainer:
         weights_np = self._round_weights(round_idx)
         weights = jnp.asarray(weights_np)
         chaos_extra = self._chaos_batch_keys(round_idx)
+        sync_entry = None
+        if self._sync_takes_entry:
+            # the codec sync compresses ROUND DELTAS, so it needs the
+            # round-entry param trees. Copied (not referenced): the step
+            # dispatches below donate the state buffers, so a live alias
+            # would be invalidated by the first step of the epoch.
+            sync_entry = jax.tree_util.tree_map(
+                jnp.copy, (self.state.user_params, self.state.news_params)
+            )
         if self.flightrec is not None:
             self.flightrec.start_chunk(
                 round_idx, self._entry_state(),
@@ -1778,10 +1899,17 @@ class Trainer:
 
         if self.strategy.sync_params_every_round:
             with tracer.span(
-                "aggregate", round=round_idx, method=cfg.fed.robust.method
+                "aggregate", round=round_idx, method=cfg.fed.robust.method,
+                **self._uplink_span_args(weights_np),
             ):
-                self.state = self.param_sync(self.state, weights)
+                if sync_entry is not None:
+                    self.state = self.param_sync(
+                        self.state, weights, *sync_entry
+                    )
+                else:
+                    self.state = self.param_sync(self.state, weights)
             self._m_robust_rounds.inc(method=cfg.fed.robust.method)
+            self._count_uplink(weights_np)
             if self.server_opt is not None:
                 # FedOpt: the weighted mean is a proposal, not the new model —
                 # the server optimizer steps the global from round_start
@@ -2026,6 +2154,7 @@ class Trainer:
             )
         if self.strategy.sync_params_every_round:
             self._m_robust_rounds.inc(num_rounds, method=cfg.fed.robust.method)
+            self._count_uplink(weights)
 
         mean_loss = np.asarray(metrics["mean_loss"])  # (rounds, steps, clients)
         raw_loss = np.asarray(metrics["loss"])

@@ -19,6 +19,12 @@
 #      rounds with finite losses, over-selection visible (80 sampled),
 #      dropouts/deadline-cuts counted, quorum held, and the whole run
 #      (losses AND churn counters) bit-identical on re-run.
+#   6. COMPRESSED (ISSUE-7): the population scenario with the sign1bit
+#      update codec (error feedback on) + trimmed-mean aggregation —
+#      robust x compress via decode-before-reduce. Must survive with
+#      finite losses, bank measured uplink bytes (Communication section
+#      in the report, ratio > 20x), and replay bit-identically from the
+#      chaos seed.
 #
 #   scripts/chaos_smoke.sh     # or: make chaos-smoke
 #
@@ -49,28 +55,28 @@ CHAOS=(
     --set obs.health.abort_on_nonfinite=false
 )
 
-echo "== [1/5] fault-free trimmed-mean baseline =="
+echo "== [1/6] fault-free trimmed-mean baseline =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/baseline" "${SMALL[@]}" \
     --set train.snapshot_dir="$OUT/base_snap" \
     > "$OUT/baseline.log" 2>&1 || { tail -30 "$OUT/baseline.log"; exit 1; }
 
-echo "== [2/5] chaos run: 30% dropout + nan client + x100 poison client =="
+echo "== [2/6] chaos run: 30% dropout + nan client + x100 poison client =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/chaos_a" "${SMALL[@]}" "${CHAOS[@]}" \
     --set train.snapshot_dir="$OUT/chaos_a_snap" \
     > "$OUT/chaos_a.log" 2>&1 || { tail -30 "$OUT/chaos_a.log"; exit 1; }
 
-echo "== [3/5] determinism: same plan, bit-identical trajectory =="
+echo "== [3/6] determinism: same plan, bit-identical trajectory =="
 run python -m fedrec_tpu.cli.run 3 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/chaos_b" "${SMALL[@]}" "${CHAOS[@]}" \
     --set train.snapshot_dir="$OUT/chaos_b_snap" \
     > "$OUT/chaos_b.log" 2>&1 || { tail -30 "$OUT/chaos_b.log"; exit 1; }
 
-echo "== [4/5] recovery: nan client + fed.robust.recover=true =="
+echo "== [4/6] recovery: nan client + fed.robust.recover=true =="
 run python -m fedrec_tpu.cli.run 4 8 10 --strategy param_avg --clients 8 \
     --mode joint --synthetic --synthetic-train 256 --synthetic-news 64 \
     --obs-dir "$OUT/recover" "${SMALL[@]}" \
@@ -89,7 +95,7 @@ POP=(
     --set chaos.pop_drop_rate=0.2 --set chaos.pop_straggle_ms=50
 )
 
-echo "== [5/5] population: 1024 logical clients, 64/round, 20% dropout =="
+echo "== [5/6] population: 1024 logical clients, 64/round, 20% dropout =="
 run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
     --mode joint --synthetic --synthetic-train 2048 --synthetic-news 64 \
     --obs-dir "$OUT/pop_a" "${SMALL[@]}" "${POP[@]}" \
@@ -100,6 +106,23 @@ run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
     --obs-dir "$OUT/pop_b" "${SMALL[@]}" "${POP[@]}" \
     --set train.snapshot_dir="$OUT/pop_b_snap" \
     > "$OUT/pop_b.log" 2>&1 || { tail -30 "$OUT/pop_b.log"; exit 1; }
+
+COMPRESS=(
+    --set fed.dcn_compress=sign1bit
+    --set fed.robust.trim_k=1
+)
+
+echo "== [6/6] compressed: sign1bit + trimmed_mean + population dropout =="
+run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
+    --mode joint --synthetic --synthetic-train 2048 --synthetic-news 64 \
+    --obs-dir "$OUT/comp_a" "${SMALL[@]}" "${POP[@]}" "${COMPRESS[@]}" \
+    --set train.snapshot_dir="$OUT/comp_a_snap" \
+    > "$OUT/comp_a.log" 2>&1 || { tail -30 "$OUT/comp_a.log"; exit 1; }
+run python -m fedrec_tpu.cli.run 3 2 10 --strategy param_avg --clients 64 \
+    --mode joint --synthetic --synthetic-train 2048 --synthetic-news 64 \
+    --obs-dir "$OUT/comp_b" "${SMALL[@]}" "${POP[@]}" "${COMPRESS[@]}" \
+    --set train.snapshot_dir="$OUT/comp_b_snap" \
+    > "$OUT/comp_b.log" 2>&1 || { tail -30 "$OUT/comp_b.log"; exit 1; }
 
 run python - "$OUT" <<'EOF'
 import json, math, sys
@@ -153,11 +176,31 @@ assert part_a["cohort_reporting"] >= 16, part_a         # quorum held
 assert part_a.get("dropouts", 0) > 0, part_a            # churn visible
 assert part_a == part_b, f"population churn not bit-identical:\n{part_a}\n{part_b}"
 
+# leg 6: sign1bit + trimmed_mean + population dropout (robust x compress)
+ca, cb = losses("comp_a"), losses("comp_b")
+assert len(ca) == 3 and all(map(_math.isfinite, ca)), f"compressed run not finite: {ca}"
+assert ca == cb, f"compressed trajectory not bit-identical:\n{ca}\n{cb}"
+
+def comm_section(d):
+    records, snaps = load_jsonl(out / d / "metrics.jsonl")
+    return build_report(records, snaps).get("communication")
+
+comm = comm_section("comp_a")
+assert comm and comm["bytes_up"].get("cohort", 0) > 0, comm   # measured uplink
+assert comm["compression_ratio"] > 20, comm                   # ~32x sign1bit
+assert comm == comm_section("comp_b"), "compressed byte accounting not bit-identical"
+crb = None
+records_c, snaps_c = load_jsonl(out / "comp_a" / "metrics.jsonl")
+crb = build_report(records_c, snaps_c).get("robustness")
+assert crb and crb.get("robust_method") == "trimmed_mean", crb  # decode-before-reduce ran
+
 print("chaos smoke OK")
 print(f"  baseline   losses: {base}")
 print(f"  chaos      losses: {a}  (bit-identical on re-run)")
 print(f"  recovery   losses: {rec}  rollbacks={rrb['rollbacks']:.0f} quarantines={rrb['quarantines']:.0f}")
 print(f"  population losses: {pa}  (bit-identical on re-run)")
+print(f"  compressed losses: {ca}  (sign1bit+trimmed_mean, bit-identical on re-run; "
+      f"uplink {comm['bytes_up']['cohort']/2**20:.2f} MB at {comm['compression_ratio']:.0f}x)")
 print(f"  population churn : sampled={part_a['cohort_sampled']:.0f} reporting={part_a['cohort_reporting']:.0f} "
       f"dropouts={part_a.get('dropouts', 0):.0f} deadline_cuts={part_a.get('deadline_cuts', 0):.0f} "
       f"coverage={part_a.get('coverage', 0):.1%}")

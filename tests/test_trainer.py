@@ -802,35 +802,35 @@ def test_coordinator_cli_server_opt(tmp_path):
 
 def test_quantize_dequantize_bounds():
     """int8 round-trip error is bounded by scale/2 per element; zero tensors
-    and weighted means are exact in expectation structure."""
-    from fedrec_tpu.parallel.multihost import (
-        dequantize_weighted_mean,
-        quantize_leaf,
-    )
+    are exact; the decode-before-reduce masked weighted mean the coordinator
+    applies to gathered stacks drops a w=0 contribution entirely. (The
+    ad-hoc multihost quantizer this pinned moved into fedrec_tpu.comms.)"""
+    from fedrec_tpu.comms import decode_leaf, encode_leaf, payload_nbytes
 
     rng = np.random.default_rng(0)
     p = rng.standard_normal((64, 32)).astype(np.float32)
-    q, s = quantize_leaf(p)
-    assert q.dtype == np.int8 and s > 0
-    np.testing.assert_allclose(q.astype(np.float32) * s, p, atol=s / 2 + 1e-9)
+    pay = encode_leaf(p, "int8")
+    s = float(pay["scale"])
+    assert pay["q"].dtype == np.int8 and s > 0
+    np.testing.assert_allclose(
+        decode_leaf(pay, "int8", p.shape), p, atol=s / 2 + 1e-9
+    )
+    assert payload_nbytes(pay) == p.size + 4  # real wire buffer: q + scale
 
-    qz, sz = quantize_leaf(np.zeros((4, 4), np.float32))
-    assert sz == 0.0 and not qz.any()
+    z = encode_leaf(np.zeros((4, 4), np.float32), "int8")
+    assert float(z["scale"]) == 0.0 and not z["q"].any()
 
-    # weighted mean of 3 fake processes == hand-computed dequantized mean
+    # weighted mean over per-process DECODED stacks == hand-computed
+    # dequantized mean; a dropped-out process (w=0) contributes nothing:
+    # identical to the mean computed with that process excluded entirely
     ps = [rng.standard_normal((8,)).astype(np.float32) for _ in range(3)]
-    pairs = [quantize_leaf(x) for x in ps]
-    gq = np.stack([x[0] for x in pairs])
-    gs = np.asarray([x[1] for x in pairs])
+    dec = np.stack(
+        [decode_leaf(encode_leaf(x, "int8"), "int8", x.shape) for x in ps]
+    )
     w = np.asarray([1.0, 0.0, 2.0], np.float32)
-    got = dequantize_weighted_mean(gq, gs, w)
-    want = sum(wi * q.astype(np.float32) * s for wi, (q, s) in zip(w, pairs)) / w.sum()
-    np.testing.assert_allclose(got, want, rtol=1e-6)
-    # dropped-out process (w=0) contributes nothing: identical to the mean
-    # computed with that process excluded entirely
-    excluded = (1.0 * pairs[0][0].astype(np.float32) * pairs[0][1]
-                + 2.0 * pairs[2][0].astype(np.float32) * pairs[2][1]) / 3.0
-    np.testing.assert_allclose(got, excluded, rtol=1e-6)
+    got = np.einsum("p,p...->...", w / w.sum(), dec)
+    np.testing.assert_allclose(got, (1.0 * dec[0] + 2.0 * dec[2]) / 3.0,
+                               rtol=1e-6)
 
 
 def test_local_strategy_eval_averages_divergent_clients(tmp_path):
@@ -883,7 +883,7 @@ def test_quantize_delta_tighter_than_absolute():
     """Delta quantization (ADVICE r2): with a shared round-start base, the
     int8 error is bounded by the DELTA's range, not the parameter's — an
     outlier weight no longer destroys the whole tensor's resolution."""
-    from fedrec_tpu.parallel.multihost import quantize_leaf
+    from fedrec_tpu.comms import decode_leaf, encode_leaf
 
     rng = np.random.default_rng(1)
     base = rng.standard_normal(512).astype(np.float32)
@@ -892,11 +892,12 @@ def test_quantize_delta_tighter_than_absolute():
     p = base + delta
 
     # absolute quantization: error floor set by the outlier, ~0.4 worst case
-    q_abs, s_abs = quantize_leaf(p)
-    err_abs = np.max(np.abs(q_abs.astype(np.float32) * s_abs - p))
+    err_abs = np.max(np.abs(
+        decode_leaf(encode_leaf(p, "int8"), "int8", p.shape) - p
+    ))
     # delta quantization: error bounded by max|delta|/254 ~ 2e-5
-    q_d, s_d = quantize_leaf(p - base)
-    err_d = np.max(np.abs((q_d.astype(np.float32) * s_d + base) - p))
+    d_dec = decode_leaf(encode_leaf(p - base, "int8"), "int8", p.shape)
+    err_d = np.max(np.abs((d_dec + base) - p))
     assert err_d < 1e-4 < err_abs
     # quantization bound max|delta|/254 plus the f32 rounding floor of the
     # subtraction/add at the outlier's magnitude (eps * 100 ~ 1.2e-5)
