@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 native obs-smoke chaos-smoke comm-cost
+.PHONY: t1 native obs-smoke chaos-smoke comm-cost pallas-bench
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -23,6 +23,13 @@ chaos-smoke:
 # banks benchmarks/comm_cost.json
 comm-cost:
 	@python benchmarks/comm_cost.py
+
+# attention/fused-kernel microbenchmark: XLA dense vs pallas vs chunked at
+# H in {50,1024,2048,4096} plus the fused hot-path legs (B in {256,1024} +
+# the gather+encode leg); refuses to run off-TPU (interpret mode measures
+# nothing) — benchmarks/chip_watcher.sh queues it for the next live window
+pallas-bench:
+	@python benchmarks/pallas_bench.py
 
 native:
 	$(MAKE) -C native

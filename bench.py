@@ -490,20 +490,18 @@ def main() -> None:
                 batch_maker=None):
         """Overhead-corrected sec/step.
 
-        Two honesty rules learned on the axon tunnel (verified against a
-        known 8192^3 bf16 matmul): (1) ``block_until_ready`` does NOT wait
-        for remote execution — only a host readback does; (2) each
-        synchronized chain pays a fixed ~65 ms tunnel round-trip, so the
-        per-step time is taken from the DIFFERENCE of a 2x-length and a
-        1x-length chain, cancelling the constant.
-
-        NOTE: ``benchmarks/pallas_bench.py`` ``_time()`` implements the same
-        protocol for op-level chains (step_profile.py imports it from
-        there). Any change to the jitter-floor threshold or chain-growth
-        policy must be applied to BOTH, or the repo's perf numbers stop
-        being comparable; merging them is deferred until a live chip can
-        re-validate the merged timer.
+        The differencing protocol (and the axon-tunnel honesty rules it
+        encodes — readback-only synchronization, RTT cancellation by
+        2x/1x chain differencing, jitter-floor chain growth) lives in ONE
+        place now: ``fedrec_tpu.utils.chain_timer`` — shared with
+        ``benchmarks/pallas_bench.py``'s op-level ``_time()`` (which
+        step_profile.py imports), so the repo's perf numbers stay
+        comparable by construction. This call site keeps its historical
+        policy bits: 4 attempts, strict raise when the delta never clears
+        the 0.3 s floor.
         """
+        from fedrec_tpu.utils.chain_timer import differenced_chain_seconds
+
         the_step = the_step or step
         feats = token_states if feats is None else feats
         state0 = init_client_state(
@@ -525,29 +523,9 @@ def main() -> None:
         _tr(f"measure(bsz={bsz}, iters={iters}) warmup start")
         chain(warmup)  # compile + steady-state
         _tr("warmup done")
-        # the differenced signal must dwarf RTT jitter, not merely be
-        # positive — a tiny positive delta over-reports throughput as badly
-        # as the clamp this replaced; grow the chain until it does
-        for _ in range(4):
-            t1 = chain(iters)
-            t2 = chain(2 * iters)
-            delta = t2 - t1
-            _tr(f"t1={t1:.2f} t2={t2:.2f} delta={delta:.2f} iters={iters}")
-            if delta >= 0.3:
-                return delta / iters
-            if delta <= 0:
-                # nonsense sign: compile/dispatch residue from a short
-                # warmup landed in the 1x chain (observed on the CPU
-                # fallback). The 0.3/per_step growth rule would explode
-                # straight to the 2000-iter cap — hours at CPU step times;
-                # double and re-measure instead
-                iters = min(2000, 2 * iters)
-                continue
-            per_step = delta / iters
-            iters = int(min(2000, max(2 * iters, 0.3 / per_step)))
-        raise RuntimeError(
-            f"differenced step time never cleared the jitter floor "
-            f"(last t1={t1:.4f}, t2={t2:.4f}, iters={iters}); rerun"
+        return differenced_chain_seconds(
+            chain, iters, attempts=4, accept_positive_at_cap=False,
+            label=f"step (B={bsz})", trace=_tr,
         )
 
     # Flagship step: unique-news cap ON (VERDICT r2 item 3) — on the CPU
@@ -634,6 +612,65 @@ def main() -> None:
         return _baseline_ratios(baseline_path, rate, our_sweep)
 
     out.update(baseline_ratios(samples_per_sec))
+
+    if not on_tpu:
+        # fused hot-path leg, CPU-honest form: interpret-mode Pallas runs
+        # the grid as a host loop, so this measures the EMULATION, not the
+        # chip — it exists to prove the fused step runs end-to-end through
+        # the real step builder and to bank an explicitly-labeled verdict
+        # while the tunnel is down (the real-chip fused row lands via
+        # chip_watcher's bench item at the next window). Reduced scale
+        # (B=8, 256-news corpus) because interpret pays ~ms per grid step.
+        try:
+            import copy as _copy
+
+            bf, nn_f = 8, 256
+            cfg_fused = _copy.deepcopy(cfg)
+            cfg_fused.model.fuse_hot_path = True
+            model_fused = NewsRecommender(cfg_fused.model)
+            step_fused = build_fed_train_step(
+                model_fused, cfg_fused, get_strategy("grad_avg"), mesh,
+                mode="joint",
+            )
+
+            def make_small_batch(seed: int, bsz: int, n_clients: int = 1):
+                r = np.random.default_rng(seed)
+                return shard_batch(
+                    mesh,
+                    {
+                        "candidates": r.integers(
+                            0, nn_f, (n_clients, bsz, C)
+                        ).astype(np.int32),
+                        "history": r.integers(
+                            0, nn_f, (n_clients, bsz, H)
+                        ).astype(np.int32),
+                        "labels": np.zeros((n_clients, bsz), np.int32),
+                    },
+                )
+
+            feats_f = token_states[:nn_f]
+            dt_fu = measure(
+                bf, iters=2, warmup=2, the_step=step_fused,
+                feats=feats_f, the_cfg=cfg_fused, batch_maker=make_small_batch,
+            )
+            dt_de = measure(
+                bf, iters=2, warmup=2, feats=feats_f,
+                batch_maker=make_small_batch,
+            )
+            out["fused_cpu_interpret"] = {
+                "batch_size": bf,
+                "num_news": nn_f,
+                "fused_samples_per_sec": round(bf / dt_fu, 2),
+                "dense_samples_per_sec": round(bf / dt_de, 2),
+                "note": (
+                    "interpret-mode emulation on CPU: proves the fused "
+                    "step's code path end-to-end; says NOTHING about chip "
+                    "speed — quote fused_b1024_samples_per_sec from a "
+                    "real-chip row only"
+                ),
+            }
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] cpu fused leg failed: {e}\n")
 
     cache_path = Path(__file__).parent / "benchmarks" / "last_tpu_bench.json"
     if not on_tpu and cache_path.exists():
@@ -957,6 +994,41 @@ def main() -> None:
             stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] round-scan bonus metric failed: {e}\n")
+
+        # fused hot-path kernels (model.fuse_hot_path, ISSUE 8): the same
+        # joint step with the gather+encode and attention+pool+score chains
+        # each compiled into one Pallas kernel. Measured at B=1024 — the
+        # sweep's MFU-peak batch — against the config-matched unfused sweep
+        # row; the acceptance bar is fused ahead of unfused at B>=1024.
+        # A bonus metric: its failure must not discard the primary numbers.
+        try:
+            cfg_fused = copy.deepcopy(cfg)
+            cfg_fused.model.fuse_hot_path = True
+            model_fused = NewsRecommender(cfg_fused.model)
+            step_fused = build_fed_train_step(
+                model_fused, cfg_fused, get_strategy("grad_avg"), mesh,
+                mode="joint",
+            )
+            bf = 1024
+            dt_fused = measure(
+                bf, iters=20, the_step=step_fused, the_cfg=cfg_fused
+            )
+            out["fused_b1024_samples_per_sec"] = round(bf / dt_fused, 2)
+            base = (out.get("b_sweep_samples_per_sec") or {}).get(str(bf))
+            if base:
+                out["fused_vs_unfused_b1024"] = round(
+                    out["fused_b1024_samples_per_sec"] / base, 3
+                )
+            if peak is not None:
+                # identical math to the dense step, so the same analytic
+                # FLOPs model applies
+                out["fused_mfu_b1024"] = round(
+                    _flops_per_train_step(cfg, bf, num_news) / dt_fused / peak,
+                    4,
+                )
+            stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] fused bonus metric failed: {e}\n")
 
         # decoupled (reference-parity) mode: the text tower leaves the step —
         # news vecs come from a precomputed (N, D) table gather; this is the

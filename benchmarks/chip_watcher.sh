@@ -87,7 +87,12 @@ while :; do
     echo "[watcher] $(date -u +%FT%TZ) chip live; draining queue ($remaining left)"
     # short, high-information items first: windows have measured ~20 min
     # (2026-08-01 08:28-08:48Z window closed mid-bench), so the roofline
-    # verdict and the serving row must not queue behind an accuracy leg
+    # verdict and the serving row must not queue behind an accuracy leg.
+    # ISSUE 8: the bench item now also banks the fused-hot-path B=1024
+    # leg (fused_b1024_samples_per_sec / fused_vs_unfused_b1024 /
+    # fused_mfu_b1024) and the pallas item the fused-kernel micro legs
+    # (B in {256,1024} + gather+encode) — a fresh tree queues both
+    # automatically (markers are per-checkout).
     run_item bench 2400 python bench.py
     run_item step_profile 1800 python benchmarks/step_profile.py
     run_item serve 1800 python benchmarks/serve_bench.py

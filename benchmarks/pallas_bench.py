@@ -37,20 +37,19 @@ if _REPO not in sys.path:  # runnable as `python benchmarks/pallas_bench.py`
 def _time(fn, *args, iters: int = 30) -> float:
     """Honest per-call seconds on the axon-tunnel TPU.
 
-    ``block_until_ready`` does not wait for remote execution there (verified
-    against a known-FLOPs 8192^3 matmul: it reported 60 PFLOP/s on a
-    197-TFLOP/s chip), and separate same-args dispatches overlap. So the op
-    runs INSIDE one jitted ``lax.scan`` with a scalar data dependency
-    between iterations, synchronization is a host readback, and the fixed
-    tunnel round-trip cancels by differencing a 2x-length chain.
-
-    NOTE: ``bench.py`` ``measure()`` implements the same protocol for
-    whole-train-step chains. Any change to the differencing policy must be
-    applied to BOTH (see the note there); merging is deferred until a live
-    chip can re-validate a shared timer.
+    The op runs INSIDE one jitted ``lax.scan`` with a scalar data
+    dependency between iterations, synchronization is a host readback, and
+    the fixed tunnel round-trip cancels by differencing a 2x-length chain.
+    The differencing protocol (and its caveats) lives in ONE place —
+    ``fedrec_tpu.utils.chain_timer`` — shared with ``bench.py measure()``;
+    this call site keeps its historical policy bits: 6 attempts, and at
+    the 2000-iter cap any positive delta is accepted (op chains hit the
+    cap on fast ops where the capped delta is still meaningful).
     """
     import jax
     import jax.numpy as jnp
+
+    from fedrec_tpu.utils.chain_timer import differenced_chain_seconds
 
     def looped(n):
         @jax.jit
@@ -75,42 +74,19 @@ def _time(fn, *args, iters: int = 30) -> float:
 
         return run
 
-    def timed(run, repeats=2):
+    def chain(n: int) -> float:
+        run = looped(n)
         np.asarray(run(*args))  # compile + warm
         best = float("inf")
-        for _ in range(repeats):
+        for _ in range(2):
             t0 = time.perf_counter()
             np.asarray(run(*args))
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # grow the chain until the DIFFERENCED signal (iters * t_op, which
-    # excludes the fixed RTT) dwarfs the few-ms tunnel jitter — sub-ms ops
-    # at short chains produced nonsense (fwd+bwd "faster" than fwd), and a
-    # pilot based on the RTT-inclusive total undercounts for fast ops
-    target = 0.3
-    for _ in range(6):
-        measured_iters = iters
-        t1 = timed(looped(measured_iters))
-        t2 = timed(looped(2 * measured_iters))
-        delta = t2 - t1
-        if delta >= target or measured_iters >= 2000:
-            break
-        if delta <= 0:
-            # nonsense sign (jitter or warm-up residue in the 1x chain):
-            # the old 1e-7 floor jumped straight to the 2000-iter cap —
-            # hours at slow step times; double and re-measure instead.
-            # Kept in lockstep with bench.py measure() (see NOTE above).
-            iters = min(2000, 2 * measured_iters)
-            continue
-        per_op = delta / measured_iters
-        iters = int(min(2000, max(2 * measured_iters, target / per_op)))
-    if delta <= 0:
-        raise RuntimeError(
-            f"non-positive differenced time for chains of "
-            f"{measured_iters}/{2*measured_iters}; tunnel too jittery — rerun"
-        )
-    return delta / measured_iters
+    return differenced_chain_seconds(
+        chain, iters, attempts=6, accept_positive_at_cap=True, label="op"
+    )
 
 
 def main() -> int:
@@ -162,8 +138,11 @@ def main() -> int:
                 {"op": name, "H": H,
                  "xla_ms": t_x and t_x * 1e3,
                  "pallas_ms": t_p and t_p * 1e3,
-                 "chunked_ms": t_c and t_c * 1e3}
-                for name, H, t_x, t_p, t_c in rows
+                 "chunked_ms": t_c and t_c * 1e3,
+                 # dtype tags feed the evidence-driven attn_impl="auto"
+                 # resolver (fedrec_tpu.ops.autotune) per (H, dtype) regime
+                 "dtype": rest[0] if rest else "float32"}
+                for name, H, t_x, t_p, t_c, *rest in rows
             ],
             "skipped": skips, "provenance": provenance(),
         }, partial)
@@ -200,6 +179,34 @@ def main() -> int:
                      try_time(f"chunked/bwd/{H}", g_of(chunked_attention), q, k, v, mask)))
         _stamp(partial=True)
 
+        if H <= 1024:
+            # bf16 rows at the training-relevant sizes: the production TPU
+            # dtype (bench.py trains bf16), without which the
+            # evidence-driven attn_impl="auto" resolver (ops/autotune.py,
+            # exact (H, dtype) match) could never fire for bf16 models
+            qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+            rows.append((
+                "attention fwd", H,
+                try_time(f"xla/fwd16/{H}", xla_attn, qb, kb, vb, mask),
+                try_time(f"pallas/fwd16/{H}", pallas_attn, qb, kb, vb, mask),
+                try_time(f"chunked/fwd16/{H}", chunk_attn, qb, kb, vb, mask),
+                "bfloat16",
+            ))
+
+            def g16_of(fn):
+                return jax.jit(lambda q, k, v, m: jax.grad(
+                    lambda q: fn(q, k, v, m).astype(jnp.float32).sum()
+                )(q))
+
+            rows.append((
+                "attention fwd+bwd", H,
+                try_time(f"xla/bwd16/{H}", g16_of(dense_attn), qb, kb, vb, mask),
+                try_time(f"pallas/bwd16/{H}", g16_of(flash_attention), qb, kb, vb, mask),
+                try_time(f"chunked/bwd16/{H}", g16_of(chunked_attention), qb, kb, vb, mask),
+                "bfloat16",
+            ))
+            _stamp(partial=True)
+
         if H >= 2048:
             continue  # pool is O(L)-memory everywhere; 2 sizes suffice
         x = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
@@ -228,6 +235,140 @@ def main() -> int:
         ))
         _stamp(partial=True)
 
+    # ---- fused hot-path kernels (ISSUE 8): the WHOLE chain at training
+    # scale, where isolated kernels provably lose to launch overhead (the
+    # H=50 rows above are the evidence). xla_ms = the dense module chain,
+    # pallas_ms = the fused kernel — one launch amortized across
+    # gather+encode / qkv+attention+pool+score. bf16: the production chip
+    # dtype (bf16 operands, f32 accumulation in the kernels).
+    from fedrec_tpu.ops.fused_hot_path import (
+        fused_gather_encode, fused_history_score,
+    )
+
+    H50, C, T, Dh, Ah = 50, 5, 50, 768, 384
+    for Bf in (256, 1024):
+        rng = np.random.default_rng(1)
+        dt = jnp.bfloat16
+        x = jnp.asarray(rng.standard_normal((Bf, H50, D)), dt)
+        cand = jnp.asarray(rng.standard_normal((Bf, C, D)), dt)
+        ap = {
+            k: {"kernel": jnp.asarray(
+                    rng.standard_normal((D, D)) * 0.05, jnp.float32),
+                "bias": jnp.zeros((D,), jnp.float32)}
+            for k in ("w_q", "w_k", "w_v")
+        }
+        pp = {
+            "att_fc1": {"kernel": jnp.asarray(
+                            rng.standard_normal((D, hidden)) * 0.05,
+                            jnp.float32),
+                        "bias": jnp.zeros((hidden,), jnp.float32)},
+            "att_fc2": {"kernel": jnp.asarray(
+                            rng.standard_normal((hidden, 1)) * 0.05,
+                            jnp.float32),
+                        "bias": jnp.zeros((1,), jnp.float32)},
+        }
+
+        def dense_chain(x, cand):
+            q, k, v = (
+                (x @ ap[n]["kernel"].astype(dt) + ap[n]["bias"].astype(dt))
+                .reshape(Bf, H50, heads, dk)
+                for n in ("w_q", "w_k", "w_v")
+            )
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                jnp.asarray(dk, dt)
+            )
+            s = s - jnp.max(s, axis=-1, keepdims=True)
+            a = jnp.exp(s)
+            a = a / (jnp.sum(a, axis=-1, keepdims=True) + 1e-8)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(Bf, H50, D)
+            e = jnp.tanh(
+                ctx @ pp["att_fc1"]["kernel"].astype(dt)
+                + pp["att_fc1"]["bias"].astype(dt)
+            )
+            lg = (e @ pp["att_fc2"]["kernel"].astype(dt))[..., 0]
+            lg = lg - jnp.max(lg, axis=-1, keepdims=True)
+            al = jnp.exp(lg)
+            al = al / (jnp.sum(al, axis=-1, keepdims=True) + 1e-8)
+            user = jnp.einsum("bh,bhd->bd", al, ctx)
+            return jnp.einsum("bcd,bd->bc", cand, user)
+
+        fused_chain = lambda x, cand: fused_history_score(  # noqa: E731
+            x, cand, None, ap, pp, heads
+        )[0]
+        rows.append((
+            f"hist attn+pool+score fwd (B={Bf})", H50,
+            try_time(f"xla/fused_fwd/{Bf}", jax.jit(dense_chain), x, cand),
+            try_time(f"pallas/fused_fwd/{Bf}", jax.jit(fused_chain), x, cand),
+            None, "bfloat16",
+        ))
+
+        def g_of_chain(fn):
+            return jax.jit(lambda x, c: jax.grad(
+                lambda x: fn(x, c).astype(jnp.float32).sum()
+            )(x))
+
+        rows.append((
+            f"hist attn+pool+score fwd+bwd (B={Bf})", H50,
+            try_time(f"xla/fused_bwd/{Bf}", g_of_chain(dense_chain), x, cand),
+            try_time(f"pallas/fused_bwd/{Bf}", g_of_chain(fused_chain), x, cand),
+            None, "bfloat16",
+        ))
+        _stamp(partial=True)
+
+    # gather+encode at the flagship unique-cap scale (one leg: U is the
+    # lever, not B)
+    rngU = np.random.default_rng(2)
+    U = 2560
+    dtg = jnp.bfloat16
+    table = jnp.asarray(rngU.standard_normal((4096, T, Dh)), dtg)
+    uniq = jnp.asarray(rngU.permutation(4096)[:U].astype(np.int32))
+    np_ = {
+        "pool": {
+            "att_fc1": {"kernel": jnp.asarray(
+                            rngU.standard_normal((Dh, Ah)) * 0.05,
+                            jnp.float32),
+                        "bias": jnp.zeros((Ah,), jnp.float32)},
+            "att_fc2": {"kernel": jnp.asarray(
+                            rngU.standard_normal((Ah, 1)) * 0.05,
+                            jnp.float32),
+                        "bias": jnp.zeros((1,), jnp.float32)},
+        },
+        "fc": {"kernel": jnp.asarray(
+                   rngU.standard_normal((Dh, D)) * 0.05, jnp.float32),
+               "bias": jnp.zeros((D,), jnp.float32)},
+    }
+
+    def dense_gather_encode(uniq_ids, tbl):
+        states = tbl[uniq_ids]
+        p1 = np_["pool"]["att_fc1"]
+        e = jnp.tanh(
+            jnp.einsum("utd,dh->uth", states, p1["kernel"].astype(dtg))
+            + p1["bias"].astype(dtg)
+        )
+        lg = jnp.einsum(
+            "uth,h->ut", e, np_["pool"]["att_fc2"]["kernel"][:, 0].astype(dtg)
+        )
+        lg = lg - jnp.max(lg, axis=-1, keepdims=True)
+        a = jnp.exp(lg)
+        a = a / (jnp.sum(a, axis=-1, keepdims=True) + 1e-8)
+        pooled = jnp.einsum("ut,utd->ud", a, states)
+        return pooled @ np_["fc"]["kernel"].astype(dtg) + np_["fc"][
+            "bias"].astype(dtg)
+
+    rows.append((
+        f"gather+encode fwd (U={U})", T,
+        try_time(
+            "xla/gather_fwd",
+            jax.jit(lambda u: dense_gather_encode(u, table)), uniq,
+        ),
+        try_time(
+            "pallas/gather_fwd",
+            jax.jit(lambda u: fused_gather_encode(table, u, np_)), uniq,
+        ),
+        None, "bfloat16",
+    ))
+    _stamp(partial=True)
+
     def fmt(t):
         return f"{t*1e3:.3f}" if t is not None else "OOM/–"
 
@@ -235,7 +376,7 @@ def main() -> int:
           f"({getattr(jax.devices()[0], 'device_kind', '?')}), B={B}\n")
     print("| op | H | xla dense ms | pallas ms | chunked ms |")
     print("|---|---|---|---|---|")
-    for name, H, t_x, t_p, t_c in rows:
+    for name, H, t_x, t_p, t_c, *_rest in rows:
         print(f"| {name} | {H} | {fmt(t_x)} | {fmt(t_p)} | {fmt(t_c)} |")
 
     _stamp(partial=False)

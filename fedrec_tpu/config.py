@@ -118,23 +118,41 @@ class ModelConfig:
     # score->loss parity: CE over sigmoid(scores) (reference ``model.py:123-126``)
     sigmoid_before_ce: bool = True
     dtype: str = "float32"             # compute dtype for encoders ("bfloat16" on TPU)
-    # Route hot ops through the Pallas kernels. EXPERIMENTAL OPT-IN: at every
-    # chip-measured size so far the XLA dense path wins (20-dim heads pad to
-    # 128 lanes; benchmarks/pallas_bench.json), so 'auto' NEVER selects
-    # pallas unless this flag is set. In the one regime needing O(L)
-    # attention — training at H>=2048, dense fwd+bwd OOM — the r3 chip
-    # window measured pallas AHEAD of the chunked scan (255 vs 299 ms
-    # fwd+bwd at H=2048), so this opt-in is the measured-better choice
-    # there. The kernels were restructured since (grid-streamed K/V,
-    # VMEM scratch accumulators, input-dtype MXU dots); re-judge on the
-    # queued re-bench before promoting into 'auto'.
+    # Route hot ops through the ISOLATED Pallas kernels. EXPERIMENTAL
+    # OPT-IN: at every chip-measured size so far the XLA dense path wins
+    # (20-dim heads pad to 128 lanes; benchmarks/pallas_bench.json). In
+    # the one regime needing O(L) attention — training at H>=2048, dense
+    # fwd+bwd OOM — the r3 chip window measured pallas AHEAD of the
+    # chunked scan (255 vs 299 ms fwd+bwd at H=2048), so this opt-in is
+    # the measured-better choice there. For the reference H=50 scale the
+    # measured answer is fuse_hot_path below — isolated kernels lose to
+    # per-call overhead there (50x at H=50 fwd); only a fused chain can
+    # amortize the launch.
     use_pallas: bool = False
+    # Fuse the step's hot chain into two Pallas kernels
+    # (fedrec_tpu.ops.fused_hot_path): (1) frozen-table gather + text-head
+    # encode — token rows stream HBM->VMEM per unique id, the (U, T, Dh)
+    # gather never materializes; (2) user-tower QKV + per-head attention +
+    # additive pool + candidate scoring in one VMEM residency (serving's
+    # encode_user reuses it). bf16 operands / f32 accumulation; exact
+    # module epsilon semantics; blocked custom VJPs; interpret-mode CPU
+    # fallback so tier-1 runs the same code path. Requires
+    # user_tower='mha' + stable_softmax; kernel (1) additionally needs
+    # text_head_arch='additive' (cnn heads keep the dense gather+encode).
+    # Not combinable with seq_shards>1, in-device cohorts (k>1), or
+    # per-example DP-SGD — the step builders fail fast. docs/DESIGN.md §5h.
+    fuse_hot_path: bool = False
     # user-encoder self-attention implementation:
-    #   "auto"    — dense XLA up to attn_chunk_threshold history items, then
-    #               blockwise lax.scan (O(L) memory); pallas if use_pallas
+    #   "auto"    — EVIDENCE-DRIVEN when a provenance-clean
+    #               benchmarks/pallas_bench.json exists for the current
+    #               jax version and a TPU backend is live: the measured
+    #               winner for the nearest (H, dtype) regime is picked
+    #               (fedrec_tpu.ops.autotune). Otherwise the static
+    #               defaults: dense XLA up to attn_chunk_threshold history
+    #               items, then blockwise lax.scan (O(L) memory); pallas
+    #               if use_pallas (explicit opt-in still wins over
+    #               evidence).
     #   "dense" | "chunked" | "pallas" — force one path
-    # benchmarks/pallas_bench.json is the evidence behind the default: dense
-    # XLA wins at every size that fits, chunked is the long-context fallback.
     attn_impl: str = "auto"
     attn_chunk_threshold: int = 1024
 

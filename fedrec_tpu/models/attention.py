@@ -148,11 +148,24 @@ class MultiHeadAttention(nn.Module):
         impl = self.attn_impl
         if impl == "auto":
             if self.use_pallas and self.stable_softmax:
+                # explicit kernel opt-in still outranks banked evidence
                 impl = "pallas"
-            elif L > self.chunk_threshold and self.stable_softmax:
-                impl = "chunked"
             else:
-                impl = "dense"
+                # evidence-driven: the measured winner for this (H, dtype)
+                # regime from a provenance-clean pallas_bench artifact on a
+                # live TPU backend; None (no applicable clean evidence, or
+                # off-TPU) falls back to the static defaults below
+                from fedrec_tpu.ops.autotune import measured_attn_impl
+
+                measured = measured_attn_impl(L, jnp.dtype(self.dtype))
+                if measured is not None and (
+                    measured == "dense" or self.stable_softmax
+                ):
+                    impl = measured
+                elif L > self.chunk_threshold and self.stable_softmax:
+                    impl = "chunked"
+                else:
+                    impl = "dense"
         if impl == "pallas":
             # blocked online-softmax kernel: no (..., H, L, L) score tensor
             from fedrec_tpu.ops import flash_attention

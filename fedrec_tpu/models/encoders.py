@@ -155,8 +155,53 @@ class GRUUserEncoder(nn.Module):
         )(outs, mask)
 
 
+class _AttnParams(nn.Module):
+    """Parameter owner for the fused path: creates ``MultiHeadAttention``'s
+    exact Dense tree (names, shapes, xavier-uniform init) on a zero-length
+    input without running the attention math — the module's own softmax
+    cannot trace L=0, and the fused kernel does the math anyway."""
+
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x0: jnp.ndarray):
+        for name in ("w_q", "w_k", "w_v"):
+            nn.Dense(
+                self.features,
+                dtype=self.dtype,
+                kernel_init=nn.initializers.xavier_uniform(),
+                name=name,
+            )(x0)
+
+
+class _PoolParams(nn.Module):
+    """``AdditiveAttention``'s Dense tree for the fused path (same
+    zero-length idiom as its own ``use_pallas`` branch)."""
+
+    hidden: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x0: jnp.ndarray):
+        fc1 = nn.Dense(self.hidden, dtype=self.dtype, name="att_fc1")
+        nn.Dense(1, dtype=self.dtype, name="att_fc2")(fc1(x0))
+
+
 class UserEncoder(nn.Module):
-    """(..., H, news_dim) clicked-news vectors -> (..., news_dim) user vector."""
+    """(..., H, news_dim) clicked-news vectors -> (..., news_dim) user vector.
+
+    ``fuse=True`` (``model.fuse_hot_path``) routes everything after the
+    dropout — Q/K/V projections, per-head attention, additive pooling, and
+    (when ``cand_vecs`` is passed) candidate scoring — through ONE fused
+    Pallas kernel (``fedrec_tpu.ops.fused_history_score``). The submodules
+    are still constructed (zero-length calls materialize the identical
+    parameter tree, so checkpoints interoperate and the dropout RNG fold is
+    byte-identical to the dense path), but their math is replaced by the
+    kernel. Requires ``stable_softmax`` and no sequence sharding; the
+    kernel reproduces the modules' exact epsilon-normalization semantics
+    (see ``fused_hot_path``'s numerics contract).
+    """
 
     news_dim: int = 400
     num_heads: int = 20
@@ -166,6 +211,7 @@ class UserEncoder(nn.Module):
     stable_softmax: bool = True
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False
+    fuse: bool = False           # model.fuse_hot_path — fused kernel route
     seq_axis: str | None = None  # shard history over this mesh axis (long context)
     seq_impl: str = "ring"
     attn_impl: str = "auto"      # see ModelConfig.attn_impl
@@ -177,8 +223,42 @@ class UserEncoder(nn.Module):
         clicked_vecs: jnp.ndarray,
         mask: jnp.ndarray | None = None,
         train: bool = False,
-    ) -> jnp.ndarray:
+        cand_vecs: jnp.ndarray | None = None,
+    ):
+        fused = self.fuse and self.seq_axis is None and self.stable_softmax
+        if cand_vecs is not None and not fused:
+            raise ValueError(
+                "UserEncoder(cand_vecs=...) is the fused-scoring entry; it "
+                "requires fuse=True (model.fuse_hot_path) with "
+                "stable_softmax and no sequence sharding"
+            )
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(clicked_vecs)
+        if fused:
+            from fedrec_tpu.ops import fused_history_score, fused_user_vector
+
+            # the param-owner modules exist purely to create the IDENTICAL
+            # parameter tree (names, shapes, inits — checkpoints and the
+            # dense path interoperate freely, pinned in tests) on
+            # zero-length inputs; the kernel does all real math
+            attn = _AttnParams(
+                features=self.num_heads * self.head_dim,
+                dtype=self.dtype,
+                name="self_attn",
+            )
+            pool = _PoolParams(
+                hidden=self.query_dim, dtype=self.dtype, name="pool"
+            )
+            z = x[..., :0, :]
+            attn(z)
+            pool(z)
+            ap = attn.variables["params"]
+            pp = pool.variables["params"]
+            if cand_vecs is None:
+                return fused_user_vector(x, mask, ap, pp, self.num_heads)
+            scores, user = fused_history_score(
+                x, cand_vecs, mask, ap, pp, self.num_heads
+            )
+            return user, scores
         x = MultiHeadAttention(
             num_heads=self.num_heads,
             head_dim=self.head_dim,

@@ -103,6 +103,25 @@ class NewsRecommender(nn.Module):
                 f"unknown model.text_head_arch {arch!r}; have 'additive', 'cnn'"
             )
         tower = getattr(self.cfg, "user_tower", "mha")
+        fuse = getattr(self.cfg, "fuse_hot_path", False)
+        if fuse:
+            if tower != "mha":
+                raise ValueError(
+                    "model.fuse_hot_path fuses the MHA user tower; "
+                    f"user_tower={tower!r} has no fused kernel — unset one"
+                )
+            if not self.cfg.stable_softmax:
+                raise ValueError(
+                    "model.fuse_hot_path requires stable_softmax=True (the "
+                    "fused kernels compute the max-subtracted form; the "
+                    "raw-exp parity mode stays on the dense path)"
+                )
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "model.fuse_hot_path cannot run under fed.seq_shards>1 "
+                    "(the fused kernel holds the whole history per row); "
+                    "use the ring/Ulysses path for sharded histories"
+                )
         if tower == "gru":
             if self.seq_axis is not None:
                 raise ValueError(
@@ -130,6 +149,7 @@ class NewsRecommender(nn.Module):
                 stable_softmax=self.cfg.stable_softmax,
                 dtype=dtype,
                 use_pallas=self.cfg.use_pallas,
+                fuse=fuse,
                 seq_axis=self.seq_axis,
                 seq_impl=self.seq_impl,
                 attn_impl=self.cfg.attn_impl,
@@ -161,6 +181,13 @@ class NewsRecommender(nn.Module):
         train: bool = False,
     ) -> jnp.ndarray:
         """(..., C, D) candidates + (..., H, D) history -> (..., C) scores."""
+        if getattr(self.cfg, "fuse_hot_path", False):
+            # one fused kernel runs attention + pool + scoring; the dot
+            # with the candidates never leaves VMEM (docs/DESIGN.md §5h)
+            _, scores = self.user_encoder(
+                his_vecs, his_mask, train, cand_vecs=cand_vecs
+            )
+            return scores
         user_vec = self.user_encoder(his_vecs, his_mask, train)
         return score_candidates(cand_vecs, user_vec)
 

@@ -17,5 +17,17 @@ routed from the Flax modules via ``ModelConfig.use_pallas``.
 
 from fedrec_tpu.ops.attention_kernels import additive_pool, flash_attention
 from fedrec_tpu.ops.chunked_attention import chunked_attention
+from fedrec_tpu.ops.fused_hot_path import (
+    fused_gather_encode,
+    fused_history_score,
+    fused_user_vector,
+)
 
-__all__ = ["additive_pool", "chunked_attention", "flash_attention"]
+__all__ = [
+    "additive_pool",
+    "chunked_attention",
+    "flash_attention",
+    "fused_gather_encode",
+    "fused_history_score",
+    "fused_user_vector",
+]

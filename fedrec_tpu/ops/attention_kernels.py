@@ -428,6 +428,52 @@ def _iter_pallas_calls(jaxpr):
             yield from _iter_pallas_calls(sub)
 
 
+def _block_index_is_constant(bm) -> bool:
+    """True when a block mapping's index map ignores the grid — the
+    pipeline then keeps ONE resident copy (weights, accumulators) instead
+    of double-buffering it. Conservative: anything unrecognizable counts
+    as varying (over-estimates VMEM, never under)."""
+    try:
+        jaxpr = bm.index_map_jaxpr.jaxpr
+        return not jaxpr.eqns and all(
+            isinstance(v, jax.core.Literal) for v in jaxpr.outvars
+        )
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _pallas_call_buffer_bytes(eqn) -> tuple[int, int]:
+    """(pipeline-buffered block bytes, scratch bytes) of one traced
+    pallas_call eqn — the shared walk behind every VMEM working-set model
+    (flash here, the fused hot-path kernels in ``fused_hot_path.py``), so
+    all of them read the same grid-mapping truth instead of
+    hand-maintained formulas. Grid-varying blocks count twice (pipeline
+    double-buffering); constant-index blocks (weights, grad accumulators)
+    count once."""
+    gm = eqn.params["grid_mapping"]
+    block_bytes = 0
+    for bm in gm.block_mappings:
+        aval = bm.block_aval
+        n = 1
+        for s in aval.shape:
+            n *= s
+        mult = 1 if _block_index_is_constant(bm) else _PIPELINE_BUFFERS
+        block_bytes += n * aval.dtype.itemsize * mult
+    # scratch operands live in the inner jaxpr's trailing invars
+    inner = eqn.params["jaxpr"]
+    n_scratch = gm.num_scratch_operands
+    scratch_bytes = 0
+    for var in (
+        inner.invars[len(inner.invars) - n_scratch:] if n_scratch else []
+    ):
+        aval = var.aval
+        n = 1
+        for s in aval.shape:
+            n *= s
+        scratch_bytes += n * aval.dtype.itemsize
+    return block_bytes, scratch_bytes
+
+
 def flash_vmem_working_set(
     lq: int,
     lk: int,
@@ -458,26 +504,10 @@ def flash_vmem_working_set(
     bias = jax.ShapeDtypeStruct((batch_heads, lk), jnp.float32)
 
     def per_call_bytes(eqn) -> int:
-        gm = eqn.params["grid_mapping"]
-        block_bytes = 0
-        for bm in gm.block_mappings:
-            aval = bm.block_aval
-            n = 1
-            for s in aval.shape:
-                n *= s
-            block_bytes += n * aval.dtype.itemsize
-        # scratch operands live in the inner jaxpr's trailing invars
-        inner = eqn.params["jaxpr"]
-        n_scratch = gm.num_scratch_operands
-        scratch_bytes = 0
-        for var in inner.invars[len(inner.invars) - n_scratch:] if n_scratch else []:
-            aval = var.aval
-            n = 1
-            for s in aval.shape:
-                n *= s
-            scratch_bytes += n * aval.dtype.itemsize
+        # buffered block bytes already carry the pipeline multiplier
+        block_bytes, scratch_bytes = _pallas_call_buffer_bytes(eqn)
         temps = _SCORE_TEMPS * block_q * block_k * 4
-        return block_bytes * _PIPELINE_BUFFERS + scratch_bytes + temps
+        return block_bytes + scratch_bytes + temps
 
     fwd_jaxpr = jax.make_jaxpr(
         lambda *a: _flash_forward(*a, block_q, block_k)

@@ -15,6 +15,12 @@ round-trips besides the final (B, k) result.
 History items are excluded by default (recommending something the user just
 read is a wasted slot); id 0 — the reference's history pad slot
 (``dataset.py:83-85``) — is always excluded.
+
+With ``model.fuse_hot_path`` the user encoding inside both scorers rides
+the fused attention+pool Pallas kernel (``ops.fused_user_vector`` via
+``encode_user`` — one launch per request batch instead of the projection/
+attention/pool op chain), then the full-catalog matmul runs as before;
+parity with the dense model is pinned in ``tests/test_fused_hot_path.py``.
 """
 
 from __future__ import annotations

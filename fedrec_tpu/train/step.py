@@ -229,6 +229,7 @@ def _encode_gathered(
     token_states: jnp.ndarray,
     uniq: jnp.ndarray,
     chunk: int = 0,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """Gather unique token-state rows and run the text head over them.
 
@@ -243,18 +244,40 @@ def _encode_gathered(
     the (unique, L, Dh) gather result then never occupies HBM beyond one
     chunk (forward residual AND backward), at the price of re-gathering
     per tile in the backward pass. Row-wise encode, so tiling is exact.
+
+    ``fused`` (``model.fuse_hot_path``, additive head only): ONE Pallas
+    kernel streams each id's token row HBM->VMEM straight into the pool +
+    projection (``ops.fused_gather_encode``) — the (U, L, Dh) gather never
+    exists, forward or backward, so the remat tag moves from the gathered
+    states (which no longer materialize) to the kernel's (U, D) output;
+    ``stop_gradient`` on the table keeps the frozen-trunk contract and the
+    kernel's VJP never computes a table cotangent anyway. Composes with
+    ``chunk`` unchanged (the tile body swaps implementations).
     """
     from jax.ad_checkpoint import checkpoint_name
 
-    def encode(ids):
-        states = checkpoint_name(
-            lax.stop_gradient(token_states[ids]), "token_gather"
-        )
-        return model.apply(
-            {"params": {"text_head": news_params}},
-            states,
-            method=NewsRecommender.encode_news,
-        )
+    if fused:
+        from fedrec_tpu.ops import fused_gather_encode
+
+        frozen = lax.stop_gradient(token_states)
+
+        def encode(ids):
+            return checkpoint_name(
+                fused_gather_encode(
+                    frozen, ids, news_params, dtype=model.cfg.dtype
+                ),
+                "token_gather",
+            )
+    else:
+        def encode(ids):
+            states = checkpoint_name(
+                lax.stop_gradient(token_states[ids]), "token_gather"
+            )
+            return model.apply(
+                {"params": {"text_head": news_params}},
+                states,
+                method=NewsRecommender.encode_news,
+            )
 
     u = uniq.shape[0]
     if not chunk or u <= chunk:
@@ -273,6 +296,7 @@ def _batch_news_vecs(
     history: jnp.ndarray,
     cap: int = 0,
     chunk: int = 0,
+    fused: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Encode the batch's unique news once; gather into cand/history slots.
 
@@ -296,7 +320,9 @@ def _batch_news_vecs(
     uniq, inv = jnp.unique(
         ids, size=size, fill_value=0, return_inverse=True
     )
-    vecs = _encode_gathered(model, news_params, token_states, uniq, chunk)
+    vecs = _encode_gathered(
+        model, news_params, token_states, uniq, chunk, fused=fused
+    )
     flat = vecs[inv]
     cand_vecs = flat[: b * c].reshape(b, c, -1)
     his_vecs = flat[b * c :].reshape(b, h, -1)
@@ -580,6 +606,33 @@ def _build_local_step(
             "noises only the news grads, which contradicts a user-only scope"
         )
 
+    # fused hot-path kernels (model.fuse_hot_path, ops.fused_hot_path):
+    # kernel (2) — attention+pool+score — rides the model modules, so it is
+    # active in every mode (and composes with in-device cohorts: the
+    # kernels batch under the cohort vmap); kernel (1) — gather+encode —
+    # replaces the joint-mode dense gather for the additive head. The
+    # unsupported combinations fail fast HERE, at build time, with the
+    # lever to unset.
+    fuse = getattr(cfg.model, "fuse_hot_path", False)
+    fuse_gather = (
+        fuse
+        and getattr(cfg.model, "text_head_arch", "additive") == "additive"
+    )
+    if fuse:
+        if use_dpsgd:
+            raise NotImplementedError(
+                "model.fuse_hot_path with privacy.mechanism='dpsgd' is not "
+                "supported (per-example clipping would pay the kernel "
+                "launch per example, exactly the overhead regime where "
+                "fusion loses); unset one of the two"
+            )
+        if n_seq > 1:
+            raise NotImplementedError(
+                "model.fuse_hot_path with fed.seq_shards>1 is not supported "
+                "(the fused kernel holds the whole history per row); use "
+                "the ring/Ulysses path for sharded histories"
+            )
+
     # in-graph numeric sentry (obs.health.sentry): the step additionally
     # returns per-client grad/update/param global norms and a non-finite
     # flag (+ DP clip-rate under dpsgd) — computed on device, fetched by
@@ -700,6 +753,7 @@ def _build_local_step(
                             batch["candidates"], batch["history"],
                             cap=cap,
                             chunk=cfg.data.gather_chunk,
+                            fused=fuse_gather,
                         )
                     if n_seq > 1:
                         # candidate encoding is replicated across seq shards;

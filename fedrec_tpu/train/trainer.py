@@ -600,6 +600,27 @@ class Trainer:
             "unique-news cap overflow count (client-summed over steps; "
             "nonzero aborts the round)",
         )
+        # per-step fusion gauge: how many fused Pallas hot-path kernels the
+        # compiled step launches (model.fuse_hot_path; 2 = gather+encode
+        # AND attention+pool+score, 1 = scoring kernel only — cnn text
+        # head keeps the dense gather — 0 = dense step). A reader of a
+        # prometheus scrape can tell WHICH program produced the step
+        # timings next to it (docs/OBSERVABILITY.md).
+        fuse_on = getattr(cfg.model, "fuse_hot_path", False)
+        fused_n = 0
+        if fuse_on:
+            # the gather+encode kernel runs only where the frozen-table
+            # gather exists: joint mode ("head") with the additive head
+            fused_n = 1 + int(
+                cfg.model.text_encoder_mode == "head"
+                and getattr(cfg.model, "text_head_arch", "additive")
+                == "additive"
+            )
+        self._g_fused = self.registry.gauge(
+            "model.fused_hot_path_kernels",
+            "fused Pallas kernels per train step (0 = dense path)",
+        )
+        self._g_fused.set(fused_n)
         # ---- robustness instruments (fedrec-obs report's Robustness
         # section reads these): always registered — zero-valued when the
         # features are off, so the section simply doesn't render

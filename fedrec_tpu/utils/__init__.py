@@ -1,4 +1,5 @@
+from fedrec_tpu.utils.chain_timer import differenced_chain_seconds
 from fedrec_tpu.utils.logging import MetricLogger
 from fedrec_tpu.utils.profiling import profile_if
 
-__all__ = ["MetricLogger", "profile_if"]
+__all__ = ["MetricLogger", "differenced_chain_seconds", "profile_if"]

@@ -1,0 +1,89 @@
+"""The ONE differencing-timer protocol for chip measurements.
+
+Previously duplicated between ``bench.py measure()`` (whole-train-step
+chains) and ``benchmarks/pallas_bench.py _time()`` (op-level scan chains),
+with a cross-referenced NOTE in each demanding lockstep edits — unified
+here so the repo's perf numbers stay comparable by construction. Both call
+sites keep their byte-identical measurement policy (thresholds, chain
+growth, cap) via the two knobs below.
+
+Axon-tunnel honesty rules, learned the hard way and verified against a
+known-FLOPs 8192^3 bf16 matmul (it "measured" 60 PFLOP/s on a 197-TFLOP/s
+chip under the naive timer):
+
+  * ``block_until_ready`` does NOT wait for remote execution over the
+    tunnel — only a host readback synchronizes, so every chain must end in
+    one (the caller's ``chain`` closure owns that);
+  * each synchronized chain pays a fixed ~65 ms tunnel round-trip, and
+    separate same-args dispatches overlap — so the per-op time is the
+    DIFFERENCE of a 2x-length and a 1x-length chain, cancelling the
+    constant;
+  * the differenced signal must DWARF the few-ms tunnel jitter, not merely
+    be positive: sub-ms ops at short chains produced nonsense (fwd+bwd
+    "faster" than fwd), and a tiny positive delta over-reports throughput
+    as badly as a clamp — chains grow until ``iters * t_op >= target``;
+  * a non-positive delta (jitter or warm-up residue in the 1x chain) must
+    DOUBLE the chain, not jump via ``target/per_op``: the old 1e-7 floor
+    exploded straight to the iteration cap — hours at slow step times.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def differenced_chain_seconds(
+    chain: Callable[[int], float],
+    iters: int,
+    *,
+    target: float = 0.3,
+    cap: int = 2000,
+    attempts: int = 4,
+    accept_positive_at_cap: bool = False,
+    label: str = "chain",
+    trace: Callable[[str], None] | None = None,
+) -> float:
+    """Per-iteration seconds from differenced 1x/2x chains.
+
+    ``chain(k)`` runs k synchronized iterations and returns wall seconds
+    (including any fixed dispatch/RTT constant — it cancels). The caller
+    warms up (compile + steady state) BEFORE calling this.
+
+    ``accept_positive_at_cap``: accept any positive delta at the
+    iteration cap OR on attempt exhaustion, raising only for a
+    non-positive delta (pallas_bench's historical policy — op chains hit
+    the cap on fast ops where the capped delta is still meaningful, and a
+    jittery window's last positive reading beats a nulled evidence row);
+    ``bench.py`` keeps the stricter raise-below-target policy for step
+    chains. These two knobs are the ONLY policy difference between the
+    call sites.
+    """
+    t1 = t2 = delta = float("nan")
+    measured = iters
+    for _ in range(attempts):
+        measured = iters
+        t1 = chain(measured)
+        t2 = chain(2 * measured)
+        delta = t2 - t1
+        if trace is not None:
+            trace(
+                f"t1={t1:.2f} t2={t2:.2f} delta={delta:.2f} iters={measured}"
+            )
+        if delta >= target:
+            return delta / measured
+        if accept_positive_at_cap and measured >= cap:
+            break
+        if delta <= 0:
+            # nonsense sign: jitter or warm-up residue landed in the 1x
+            # chain — double and re-measure (see module docstring)
+            iters = min(cap, 2 * measured)
+            continue
+        per_op = delta / measured
+        iters = int(min(cap, max(2 * measured, target / per_op)))
+    if accept_positive_at_cap and delta > 0:
+        return delta / measured
+    raise RuntimeError(
+        f"differenced {label} time never cleared the jitter floor "
+        f"(last t1={t1:.4f}, t2={t2:.4f}, iters={measured}); tunnel too "
+        "jittery — rerun"
+    )
