@@ -1,11 +1,20 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 native obs-smoke chaos-smoke comm-cost pallas-bench
+.PHONY: t1 lint check native obs-smoke chaos-smoke comm-cost pallas-bench
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
 	@bash scripts/t1.sh
+
+# static analysis: fedrec-lint (project invariants, docs/ANALYSIS.md) +
+# the generic layer (ruff when installed; builtin GL rules always)
+lint:
+	@bash scripts/lint.sh
+
+# the one local PR gate: lint, then tier-1
+check:
+	@bash scripts/check.sh
 
 # observability smoke: 2-round CPU training + serve_load, then assert the
 # artifact trio (metrics.jsonl / trace.json / prometheus.txt) renders

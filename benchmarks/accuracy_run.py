@@ -848,7 +848,7 @@ def write_report() -> None:
             "## 1. Flagship centralized run",
             "",
             f"Platform **{central['platform']}** ({central['device']}), mode",
-            f"`head` (trainable text head over cached trunk states), dtype",
+            "`head` (trainable text head over cached trunk states), dtype",
             f"`{central['config']['dtype']}`, lr {central['config']['lr']},",
             f"batch {central['config']['batch']}. Corpus: {central['corpus']['train']:,}",
             f"train / {central['corpus']['valid']:,} valid impressions over",
@@ -918,7 +918,7 @@ def write_report() -> None:
             "",
             "## 2b. Privacy-utility tradeoff (DP-tuned recipe)",
             "",
-            f"DP-SGD sweep with hyperparameters tuned FOR the DP estimator",
+            "DP-SGD sweep with hyperparameters tuned FOR the DP estimator",
             f"(`{r['strategy']}`, {r['clients']} clients, clip C={r['clip_norm']},",
             f"Adam lr {r['lr']}, {r['rounds']} rounds; accountant budgets the",
             f"steps actually trained, delta={r['delta']}). The non-private",

@@ -21,7 +21,6 @@ the script refuses to run unless a TPU backend is live (or --force).
 from __future__ import annotations
 
 import argparse
-import json
 import time
 from pathlib import Path
 

@@ -16,7 +16,6 @@ Usage: python benchmarks/serve_bench.py [--cpu] [--num-news 65000]
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from pathlib import Path

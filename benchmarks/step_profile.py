@@ -32,7 +32,6 @@ Run on TPU:  python benchmarks/step_profile.py
 
 from __future__ import annotations
 
-import json
 import sys
 from pathlib import Path
 

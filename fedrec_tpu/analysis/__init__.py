@@ -1,0 +1,63 @@
+"""``fedrec_tpu.analysis`` — the project-invariant static-analysis subsystem.
+
+An AST-based lint engine (stdlib ``ast``, zero new dependencies) that
+machine-checks the invariants the codebase previously enforced by
+convention and review:
+
+* ``trace_safety``  (TS1xx) — no host syncs / trace-time host values
+  inside jitted scopes;
+* ``config_contract`` (CC2xx) — every ``cfg.*`` read declared, every
+  declared default read, every flag documented;
+* ``metric_contract`` (MC3xx) — every registry metric catalogued in
+  docs/OBSERVABILITY.md, Prometheus-exposable, kind-consistent;
+* ``feature_matrix`` (FM4xx) — fail-fast guards ⟷
+  ``analysis/feature_matrix.toml`` ⟷ the generated docs table;
+* ``donation`` (DA5xx) — no reads of donated buffers after dispatch;
+* ``generic`` (GL9xx) — pyflakes-subset hygiene (unused imports, ...).
+
+Entry points: the ``fedrec-lint`` CLI (``fedrec_tpu.cli.lint``),
+``make lint`` / ``make check``, and :func:`run_lint` for tests.
+See docs/ANALYSIS.md for the full catalogue, suppression syntax
+(``# fedrec-lint: disable=CODE``) and the baseline workflow.
+"""
+
+from .core import (
+    CODE_CATALOG,
+    Finding,
+    Project,
+    ProjectFile,
+    finding_fingerprint,
+    load_baseline,
+    parse_suppressions,
+    register_codes,
+    write_baseline,
+)
+from .engine import (
+    DEFAULT_BASELINE,
+    FILE_ANALYZERS,
+    PROJECT_ANALYZERS,
+    LintResult,
+    codes_table,
+    run_lint,
+)
+from .feature_matrix import render_table, write_docs_table
+
+__all__ = [
+    "CODE_CATALOG",
+    "DEFAULT_BASELINE",
+    "FILE_ANALYZERS",
+    "PROJECT_ANALYZERS",
+    "Finding",
+    "LintResult",
+    "Project",
+    "ProjectFile",
+    "codes_table",
+    "finding_fingerprint",
+    "load_baseline",
+    "parse_suppressions",
+    "register_codes",
+    "render_table",
+    "run_lint",
+    "write_baseline",
+    "write_docs_table",
+]

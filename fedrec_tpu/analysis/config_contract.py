@@ -1,0 +1,487 @@
+"""CC2xx — the config contract: declared ⟷ read ⟷ documented.
+
+``config.py`` is the single source of truth for every ``cfg.<section>.<key>``
+flag.  Three drifts are possible as the tree grows, and each gets a code:
+
+* **CC201** — a ``cfg.<section>.<key>`` attribute read that does NOT
+  resolve to a declared default (typo'd key, or a flag someone removed).
+  ``from_dict`` would only catch this at runtime, on the config path that
+  actually executes.
+* **CC202** — a declared default that is never read anywhere in the
+  package, benchmarks or CLIs (dead flag: it parses, round-trips, and does
+  nothing — the worst kind of knob).
+* **CC203** — a declared flag that appears in no README/docs flag table
+  (doc drift: the flag works but operators can't discover it).
+
+Read detection understands the codebase's real access idioms:
+
+* direct chains rooted at ``cfg``/``config`` or ``self.cfg``/``self.config``
+  (``cfg.fed.robust.method``);
+* section aliases — ``rb = cfg.fed.robust`` then ``rb.method``, at function
+  or ``self.attr`` scope;
+* annotation aliases — a parameter or class attribute annotated with a
+  config dataclass (``data_cfg: DataConfig``) makes ``data_cfg.shuffle`` a
+  read of ``data.shuffle``;
+* ``getattr(cfg.model, "fuse_hot_path", default)`` guarded reads.
+
+Documentation detection accepts a flag if its full dotted path appears
+backticked in README.md or docs/*.md, or its bare key appears backticked on
+a line that also mentions the section prefix (the grouped-row idiom:
+```chaos.pop_drop_rate` / `pop_straggle_ms```).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import Finding, Project, dotted_name, register_codes
+
+CODES = {
+    "CC201": "config attribute read with no declared default in config.py",
+    "CC202": "declared config default never read anywhere (dead flag)",
+    "CC203": "declared config flag absent from every README/docs flag table",
+}
+register_codes("config_contract", CODES)
+
+CONFIG_MODULE = "fedrec_tpu/config.py"
+ROOT_CLASS = "ExperimentConfig"
+CFG_ROOT_NAMES = {"cfg", "config"}
+DOC_GLOBS = ("README.md", "docs/*.md")
+
+
+# ------------------------------------------------------------- declarations
+
+
+@dataclass
+class ConfigSchema:
+    """Parsed shape of config.py: sections, nested sections, keys."""
+
+    # "fed" -> class name; "fed.robust" -> class name; ...
+    section_class: dict[str, str] = field(default_factory=dict)
+    # "fed.robust" -> {"method", "trim_k", ...}
+    section_keys: dict[str, set[str]] = field(default_factory=dict)
+    # class name -> list of section paths using it (usually one)
+    class_paths: dict[str, list[str]] = field(default_factory=dict)
+    # (section_path, key) -> declaration line in config.py
+    decl_lines: dict[tuple[str, str], int] = field(default_factory=dict)
+
+    def all_flags(self) -> list[tuple[str, str]]:
+        return sorted(
+            (path, key)
+            for path, keys in self.section_keys.items()
+            for key in keys
+        )
+
+    def resolve(self, parts: list[str]) -> tuple[str, str] | str | None:
+        """Resolve ["fed","robust","method"] -> ("fed.robust", "method");
+        a pure section path returns the section string; unknown -> None."""
+        if not parts or parts[0] not in self.section_class:
+            return None
+        path = parts[0]
+        i = 1
+        while i < len(parts):
+            candidate = f"{path}.{parts[i]}"
+            if candidate in self.section_class:
+                path = candidate
+                i += 1
+                continue
+            break
+        if i == len(parts):
+            return path  # section reference, not a key read
+        # first non-section component is the key; anything after it is
+        # method/attribute access ON the value (cfg.data.data_dir.rstrip)
+        return (path, parts[i])
+
+
+def _dataclass_fields(node: ast.ClassDef) -> dict[str, ast.AnnAssign]:
+    out = {}
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            out[stmt.target.id] = stmt
+    return out
+
+
+def _nested_class(ann: ast.AnnAssign, classes: set[str]) -> str | None:
+    """Return the config-class name this field nests, if any — from the
+    annotation (``robust: RobustConfig``) or ``field(default_factory=X)``."""
+    ann_name = dotted_name(ann.annotation)
+    if ann_name in classes:
+        return ann_name
+    v = ann.value
+    if isinstance(v, ast.Call) and dotted_name(v.func) == "field":
+        for kw in v.keywords:
+            if kw.arg == "default_factory":
+                name = dotted_name(kw.value)
+                if name in classes:
+                    return name
+    return None
+
+
+def load_schema(project: Project) -> ConfigSchema | None:
+    pf = project.file(CONFIG_MODULE)
+    if pf is None:
+        return None
+    classes: dict[str, ast.ClassDef] = {
+        n.name: n for n in ast.walk(pf.tree) if isinstance(n, ast.ClassDef)
+    }
+    if ROOT_CLASS not in classes:
+        return None
+    schema = ConfigSchema()
+    class_names = set(classes)
+
+    def descend(cls_name: str, prefix: str) -> None:
+        fields = _dataclass_fields(classes[cls_name])
+        for key, ann in fields.items():
+            nested = _nested_class(ann, class_names)
+            path = f"{prefix}.{key}" if prefix else key
+            if nested is not None:
+                schema.section_class[path] = nested
+                schema.class_paths.setdefault(nested, []).append(path)
+                descend(nested, path)
+            else:
+                schema.section_keys.setdefault(prefix, set()).add(key)
+                schema.decl_lines[(prefix, key)] = ann.lineno
+
+    # top level: every ExperimentConfig field is a section
+    descend(ROOT_CLASS, "")
+    # drop the synthetic "" section (ExperimentConfig has no scalar fields,
+    # but keep the contract honest if one appears)
+    return schema
+
+
+# ------------------------------------------------------------------- reads
+
+
+def _attr_chain(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` -> ["a","b","c"]; None for non-name-rooted chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+class _FileReads(ast.NodeVisitor):
+    """Collect config reads + CC201 candidates for one file."""
+
+    def __init__(self, pf, schema: ConfigSchema):
+        self.pf = pf
+        self.schema = schema
+        self.reads: set[tuple[str, str]] = set()
+        self.findings: list[Finding] = []
+        # alias name -> section path, per enclosing function (flat is fine:
+        # config aliases are short-lived locals)
+        self.aliases: dict[str, str] = {}
+        # self.<attr> -> section path (assigned in __init__ etc.)
+        self.self_aliases: dict[str, str] = {}
+        # annotation aliases: name -> section path (from class->path map)
+        self._collect_annotation_aliases()
+
+    def _class_to_path(self, cls_name: str) -> str | None:
+        paths = self.schema.class_paths.get(cls_name)
+        return paths[0] if paths else None
+
+    @staticmethod
+    def _ann_name(node: ast.AST) -> str:
+        # handles plain names, dotted names and string annotations
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value.split(".")[-1].strip()
+        return dotted_name(node).split(".")[-1]
+
+    def _collect_annotation_aliases(self) -> None:
+        for node in ast.walk(self.pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                for a in args.posonlyargs + args.args + args.kwonlyargs:
+                    if a.annotation is None:
+                        continue
+                    ann = self._ann_name(a.annotation)
+                    path = self._class_to_path(ann)
+                    if path is not None:
+                        # `cfg: RobustConfig`-style params are safe to alias
+                        # even under a root name: _resolve_chain tries every
+                        # interpretation and keeps the valid one
+                        self.aliases[a.arg] = path
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        ann = self._ann_name(stmt.annotation)
+                        path = self._class_to_path(ann)
+                        if path is not None:
+                            self.self_aliases[stmt.target.id] = path
+
+    # ---------------------------------------------------------- resolution
+    def _resolve_chain(self, parts: list[str]) -> tuple[str, str] | str | None:
+        """Resolve an attribute chain to (section, key) / section / None,
+        honoring cfg roots, self roots, and aliases."""
+        if parts[0] == "self" and len(parts) >= 2:
+            # a section alias on self (including an annotated `cfg:
+            # ModelConfig` Flax field) wins over the whole-config root names
+            alias = self.self_aliases.get(parts[1])
+            if alias is not None:
+                return self._resolve_from(alias, parts[2:])
+            if parts[1] in CFG_ROOT_NAMES or parts[1] in ("_cfg",):
+                return self.schema.resolve(parts[2:]) if len(parts) > 2 else None
+            return None
+        # a name may be BOTH a root (`cfg: ExperimentConfig` in one function)
+        # and an alias (`cfg: PrivacyConfig` in another) within one file —
+        # the alias map is file-flat, so try every interpretation and keep
+        # the first VALID one; an invalid resolution only surfaces when no
+        # interpretation works (that's the CC201).
+        candidates = []
+        if parts[0] in CFG_ROOT_NAMES and len(parts) > 1:
+            candidates.append(self.schema.resolve(parts[1:]))
+        alias = self.aliases.get(parts[0])
+        if alias is not None:
+            candidates.append(self._resolve_from(alias, parts[1:]))
+        best = None
+        for cand in candidates:
+            if cand is None:
+                continue
+            if isinstance(cand, str):
+                return cand
+            section, key = cand
+            if key in self.schema.section_keys.get(section, set()):
+                return cand
+            best = best or cand
+        return best
+
+    def _resolve_from(self, section: str, rest: list[str]) -> tuple[str, str] | str | None:
+        if not rest:
+            return section
+        resolved = self.schema.resolve(section.split(".") + rest)
+        return resolved
+
+    def _record(self, node: ast.AST, resolved) -> None:
+        if resolved is None or isinstance(resolved, str):
+            return
+        section, key = resolved
+        if key not in self.schema.section_keys.get(section, set()):
+            self.findings.append(Finding(
+                path=self.pf.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                code="CC201",
+                message=(
+                    f"`{section}.{key}` is not declared in config.py — "
+                    f"typo'd key or removed flag (section `{section}` has "
+                    "no such default)"
+                ),
+            ))
+        else:
+            self.reads.add((section, key))
+
+    # -------------------------------------------------------------- visits
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # alias bindings: x = cfg.fed.robust / self.pcfg = cfg.fed.population
+        chain = _attr_chain(node.value)
+        if chain is not None:
+            resolved = self._resolve_chain(chain)
+            if isinstance(resolved, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.aliases[t.id] = resolved
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.self_aliases[t.attr] = resolved
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        chain = _attr_chain(node)
+        if chain is not None:
+            resolved = self._resolve_chain(chain)
+            if isinstance(resolved, tuple):
+                self._record(node, resolved)
+                return  # don't double-count inner chains
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # getattr(cfg.model, "fuse_hot_path"[, default]) guarded reads
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("getattr", "hasattr")
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            chain = _attr_chain(node.args[0])
+            if chain is not None:
+                resolved = self._resolve_chain(chain)
+                if isinstance(resolved, str):
+                    key = node.args[1].value
+                    if key in self.schema.section_keys.get(resolved, set()):
+                        self.reads.add((resolved, key))
+                    # unknown key under getattr/hasattr with a default is a
+                    # deliberate compat probe, not a typo — no CC201
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------- loose read pass
+
+# argparse namespaces share attribute names with config keys by design
+# (`args.data_dir`); never let them count as config reads
+_LOOSE_EXCLUDED_BASES = {"args", "argv", "ns", "namespace"}
+
+
+def loose_reads(project: Project, schema: ConfigSchema) -> set[tuple[str, str]]:
+    """Low-precision read detection for DEAD-FLAG accounting only (never
+    CC201): the codebase deliberately duck-types section configs
+    (``robust: Any``, ``chaos_cfg: Any``), so the precise alias pass
+    cannot see those reads.  Two unambiguous rules recover them:
+
+    * a key declared by exactly ONE section counts as read wherever
+      ``<anything>.key`` or ``getattr(x, "key", ...)`` appears (unique
+      attribution);
+    * any key counts as read when the base is a bare name equal to the
+      section's last path component, with or without a ``_cfg`` suffix
+      (``robust.trim_k``, ``model_cfg.trunk_remat``).
+    """
+    owners: dict[str, list[str]] = {}
+    for section, key in schema.all_flags():
+        owners.setdefault(key, []).append(section)
+    section_by_basename: dict[str, str] = {}
+    for section in schema.section_keys:
+        last = section.rsplit(".", 1)[-1]
+        # first writer wins; section basenames are unique in practice
+        section_by_basename.setdefault(last, section)
+        section_by_basename.setdefault(f"{last}_cfg", section)
+
+    reads: set[tuple[str, str]] = set()
+
+    def record(key: str, base_name: str | None) -> None:
+        if base_name in _LOOSE_EXCLUDED_BASES:
+            return
+        if base_name is not None:
+            section = section_by_basename.get(base_name)
+            if section is not None and key in schema.section_keys.get(
+                section, set()
+            ):
+                reads.add((section, key))
+                return
+        sections = owners.get(key, [])
+        if len(sections) == 1:
+            reads.add((sections[0], key))
+
+    for pf in project.files:
+        if pf.path == CONFIG_MODULE:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Attribute):
+                base = node.value
+                base_name = base.id if isinstance(base, ast.Name) else None
+                record(node.attr, base_name)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("getattr", "hasattr")
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                base = node.args[0]
+                base_name = base.id if isinstance(base, ast.Name) else None
+                record(node.args[1].value, base_name)
+    return reads
+
+
+# -------------------------------------------------------------------- docs
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _doc_lines(root: Path) -> list[str]:
+    lines: list[str] = []
+    for pattern in DOC_GLOBS:
+        for p in sorted(root.glob(pattern)):
+            try:
+                lines.extend(p.read_text().splitlines())
+            except OSError:
+                continue
+    return lines
+
+
+def documented_flags(root: Path, schema: ConfigSchema) -> set[tuple[str, str]]:
+    """Flags mentioned in docs: full dotted path backticked anywhere, or a
+    backticked bare key on a line that names the section prefix."""
+    doc_lines = _doc_lines(root)
+    documented: set[tuple[str, str]] = set()
+    flags = schema.all_flags()
+    by_key: dict[str, list[tuple[str, str]]] = {}
+    for section, key in flags:
+        by_key.setdefault(key, []).append((section, key))
+    for line in doc_lines:
+        tokens = set()
+        for m in _BACKTICK_RE.finditer(line):
+            for tok in re.split(r"[,\s/+]+", m.group(1)):
+                tok = tok.strip("`*.,:;()[]{}")
+                if tok:
+                    tokens.add(tok)
+        for tok in tokens:
+            if "." in tok:
+                parts = tok.split(".")
+                section, key = ".".join(parts[:-1]), parts[-1]
+                if (section, key) in flags:
+                    documented.add((section, key))
+            else:
+                for section, key in by_key.get(tok, []):
+                    if (section + ".") in line:
+                        documented.add((section, key))
+    return documented
+
+
+# ------------------------------------------------------------------ driver
+
+
+def analyze_project(project: Project) -> list[Finding]:
+    schema = load_schema(project)
+    if schema is None:
+        return [Finding(
+            path=CONFIG_MODULE, line=0, col=0, code="CC201",
+            message="config.py missing or has no ExperimentConfig — the "
+                    "config contract cannot be checked",
+        )]
+    findings: list[Finding] = []
+    reads: set[tuple[str, str]] = set()
+    for pf in project.files:
+        if pf.path == CONFIG_MODULE:
+            continue
+        visitor = _FileReads(pf, schema)
+        visitor.visit(pf.tree)
+        findings.extend(visitor.findings)
+        reads |= visitor.reads
+
+    documented = documented_flags(project.root, schema)
+    reads |= loose_reads(project, schema)
+    for section, key in schema.all_flags():
+        line = schema.decl_lines.get((section, key), 0)
+        if (section, key) not in reads:
+            findings.append(Finding(
+                path=CONFIG_MODULE, line=line, col=0, code="CC202",
+                message=(
+                    f"`{section}.{key}` is declared but never read by any "
+                    "package/benchmark/CLI code — dead flag (wire it up or "
+                    "remove it)"
+                ),
+            ))
+        if (section, key) not in documented:
+            findings.append(Finding(
+                path=CONFIG_MODULE, line=line, col=0, code="CC203",
+                message=(
+                    f"`{section}.{key}` appears in no README/docs flag "
+                    "table — operators cannot discover it (docs/CONFIG.md "
+                    "is the catch-all reference)"
+                ),
+            ))
+    return findings

@@ -1,0 +1,296 @@
+"""Shared machinery for the ``fedrec-lint`` analyzers.
+
+The engine's contract, in one place:
+
+* A **Finding** is ``(path, line, col, code, message)``.  Codes are
+  ``<family><number>`` (``TS101``, ``CC202``, ...); every analyzer owns one
+  family and registers its codes in :data:`CODE_CATALOG` so ``--list-codes``
+  and docs/ANALYSIS.md can never drift from the implementation.
+* **Suppressions** are source comments.  ``# fedrec-lint: disable=TS101``
+  (comma list) silences matching findings on that line;
+  ``# fedrec-lint: disable-next=TS101`` silences the following line;
+  ``# fedrec-lint: disable-file=TS101`` anywhere silences the whole file.
+  ``disable=all`` works in each position.  Suppressions are deliberately
+  *code-scoped* — a bare ``# fedrec-lint: disable`` is a parse error, so a
+  suppression always says what it is hiding.
+* The **baseline** is a checked-in JSON file of finding fingerprints.
+  Fingerprints hash ``(path, code, stripped source line, occurrence index)``
+  — NOT the line number — so unrelated edits above a baselined finding do
+  not resurrect it, while editing the offending line itself does.
+* A **Project** is the parsed file set the project-level analyzers (config
+  contract, metric contract, feature matrix) share; per-file analyzers
+  (trace safety, donation, generic) see one :class:`ProjectFile` at a time.
+
+Everything here is stdlib-only (``ast`` + ``re`` + ``json``); the linter
+must run in any environment the package itself runs in.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+# ----------------------------------------------------------------- findings
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint result, sortable into stable report order."""
+
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based; 0 = file-level finding
+    col: int
+    code: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+# code -> (one-line description, analyzer name); analyzers register at import
+CODE_CATALOG: dict[str, tuple[str, str]] = {}
+
+
+def register_codes(analyzer: str, codes: dict[str, str]) -> None:
+    for code, desc in codes.items():
+        existing = CODE_CATALOG.get(code)
+        if existing is not None and existing != (desc, analyzer):
+            raise ValueError(f"lint code {code!r} registered twice")
+        CODE_CATALOG[code] = (desc, analyzer)
+
+
+# ------------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedrec-lint:\s*(disable|disable-next|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_,\s]+)"
+)
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression map parsed from source comments."""
+
+    line_codes: dict[int, set[str]] = field(default_factory=dict)
+    file_codes: set[str] = field(default_factory=set)
+
+    def covers(self, finding: Finding) -> bool:
+        for codes in (self.file_codes, self.line_codes.get(finding.line, ())):
+            if "all" in codes or finding.code in codes:
+                return True
+        return False
+
+
+def parse_suppressions(src: str) -> Suppressions:
+    sup = Suppressions()
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        codes = {c.strip() for c in m.group(2).split(",") if c.strip()}
+        if kind == "disable-file":
+            sup.file_codes |= codes
+        elif kind == "disable-next":
+            sup.line_codes.setdefault(lineno + 1, set()).update(codes)
+        else:
+            sup.line_codes.setdefault(lineno, set()).update(codes)
+    return sup
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def finding_fingerprint(finding: Finding, src_lines: list[str]) -> str:
+    """Line-number-independent identity of a finding (see module docstring).
+
+    The occurrence index disambiguates identical lines (two ``import os``
+    statements) without pinning absolute positions.  FILE-level findings
+    (line 0 — stale matrix rules, drifted docs tables) have no source line
+    to anchor to, so their MESSAGE is the identity: without it, every
+    line-0 finding with the same (path, code) would collapse into one
+    fingerprint and baselining one stale rule would silence them all.
+    """
+    if not (1 <= finding.line <= len(src_lines)):
+        raw = f"{finding.path}\x00{finding.code}\x00msg\x00{finding.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+    text = src_lines[finding.line - 1].strip()
+    occurrence = 0
+    for line in src_lines[: finding.line - 1]:
+        if line.strip() == text:
+            occurrence += 1
+    raw = f"{finding.path}\x00{finding.code}\x00{text}\x00{occurrence}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def load_baseline(path: Path) -> set[str]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: Path, fingerprints: Iterable[str]) -> None:
+    payload = {
+        "format": "fedrec-lint-baseline-v1",
+        "fingerprints": sorted(set(fingerprints)),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+# ------------------------------------------------------------ project model
+
+
+@dataclass
+class ProjectFile:
+    """One parsed source file plus its derived per-file state."""
+
+    path: str                   # repo-relative, forward slashes
+    abspath: Path
+    src: str
+    tree: ast.Module
+    lines: list[str]
+    suppressions: Suppressions
+
+    @classmethod
+    def load(cls, root: Path, abspath: Path) -> "ProjectFile | None":
+        src = abspath.read_text()
+        try:
+            tree = ast.parse(src, filename=str(abspath))
+        except SyntaxError:
+            return None
+        rel = abspath.relative_to(root).as_posix()
+        return cls(
+            path=rel,
+            abspath=abspath,
+            src=src,
+            tree=tree,
+            lines=src.splitlines(),
+            suppressions=parse_suppressions(src),
+        )
+
+
+# source roots scanned by default, relative to the repo root.  tests/ are
+# deliberately excluded: they construct adversarial configs and fake traced
+# scopes on purpose (the lint fixture corpus most of all).
+DEFAULT_SCAN_ROOTS = ("fedrec_tpu", "benchmarks", "bench.py")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_python_files(root: Path, scan_roots: Iterable[str]) -> list[Path]:
+    out: list[Path] = []
+    seen: set[Path] = set()
+    for rel in scan_roots:
+        p = root / rel
+        candidates: list[Path] = []
+        if p.is_file() and p.suffix == ".py":
+            candidates = [p]
+        elif p.is_dir():
+            candidates = [
+                sub for sub in sorted(p.rglob("*.py"))
+                # skip-dirs are judged INSIDE the scan root: a repo that
+                # happens to live under an ancestor named .venv or
+                # node_modules must still scan
+                if not any(part in _SKIP_DIRS for part in sub.relative_to(p).parts)
+            ]
+        for c in candidates:
+            # overlapping roots (fedrec_tpu + fedrec_tpu/fed) must not
+            # load/analyze a file twice
+            r = c.resolve()
+            if r not in seen:
+                seen.add(r)
+                out.append(c)
+    return out
+
+
+def normalize_scan_roots(root: Path, scan_roots: Iterable[str]) -> tuple[str, ...]:
+    """Repo-relative, './'-free, forward-slash scan roots.  A root outside
+    the repo raises — silently matching nothing would make a filtered run
+    false-clean."""
+    out = []
+    for r in scan_roots:
+        p = (root / r).resolve() if not Path(r).is_absolute() else Path(r).resolve()
+        try:
+            out.append(p.relative_to(root.resolve()).as_posix())
+        except ValueError:
+            raise ValueError(
+                f"scan root {r!r} is outside the repo root {root} — "
+                "paths must name files/dirs under the tree being linted"
+            ) from None
+    return tuple(out)
+
+
+@dataclass
+class Project:
+    """The whole parsed file set, shared by project-level analyzers."""
+
+    root: Path
+    files: list[ProjectFile]
+
+    @classmethod
+    def load(
+        cls, root: Path, scan_roots: Iterable[str] = DEFAULT_SCAN_ROOTS
+    ) -> "Project":
+        root = Path(root).resolve()
+        files = []
+        for abspath in iter_python_files(root, scan_roots):
+            pf = ProjectFile.load(root, abspath)
+            if pf is not None:
+                files.append(pf)
+        return cls(root=root, files=files)
+
+    def file(self, rel: str) -> ProjectFile | None:
+        for f in self.files:
+            if f.path == rel:
+                return f
+        return None
+
+
+# ---------------------------------------------------------------- ast utils
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``jax.lax.scan(...)`` -> ``jax.lax.scan``.
+
+    Non-name bases (``foo().bar(...)``) contribute an empty head; the
+    trailing attribute path is what the analyzers match on.
+    """
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return ".".join(parts)
+
+
+def literal_str(node: ast.AST) -> str | None:
+    """Best-effort literal string: constants, implicit/explicit concatenation
+    and f-strings (literal parts only, ``{...}`` holes become ``*``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = literal_str(node.left)
+        right = literal_str(node.right)
+        if left is not None and right is not None:
+            return left + right
+    return None

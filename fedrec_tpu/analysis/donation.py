@@ -1,0 +1,208 @@
+"""DA5xx — donated buffers read after dispatch.
+
+``jax.jit(fn, donate_argnums=...)`` hands the argument's buffer to XLA as
+scratch: after the dispatch the Python array object still exists but its
+buffer is deleted, and the next read raises (or worse, on some backends,
+silently reads garbage in async dispatch).  The failure only reproduces
+when the donated path actually compiles — i.e. on the TPU, not in a CPU
+unit test — which is exactly the class of bug a static check should own.
+
+Scope (deliberately conservative, to keep the analyzer quiet on correct
+code): within one module, variables or ``self.<attr>`` slots assigned from
+``jax.jit(..., donate_argnums=<literal>)`` are *donating callables*.  At
+every call site of one, a donated positional argument that is a plain
+name is tracked through the REST of the enclosing straight-line block: a
+read before any rebinding is **DA501**.  The idiomatic rebinding
+``state, metrics = step(state, batch)`` never fires — the name is rebound
+by the very statement that donates it.
+
+Calls inside loops are not chased across iterations (the donated name is
+usually rebound by the loop's own dataflow); that asymmetry is the
+documented false-negative edge, not a false-positive one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ProjectFile, dotted_name, register_codes
+
+CODES = {
+    "DA501": "argument donated via donate_argnums is read after the dispatch",
+}
+register_codes("donation", CODES)
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Literal donate_argnums positions of a jax.jit(...) call, else None."""
+    dotted = dotted_name(call.func)
+    if not (dotted in ("jit", "pjit") or dotted.endswith(".jit") or dotted.endswith(".pjit")):
+        return None
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    out.append(elt.value)
+                else:
+                    return None  # computed positions: out of static scope
+            return tuple(out)
+        if isinstance(v, ast.IfExp):
+            # the codebase idiom: donate_argnums=(0, 1) if donate_batch
+            # else (0,) — the INTERSECTION is always donated
+            a = _literal_positions(v.body)
+            b = _literal_positions(v.orelse)
+            if a is not None and b is not None:
+                return tuple(sorted(set(a) & set(b)))
+        return None
+    return None
+
+
+def _literal_positions(node: ast.AST) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _collect_donators(tree: ast.Module) -> dict[str, tuple[int, ...]]:
+    """name / "self.attr" -> donated positions, module-wide."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        pos = _donate_positions(node.value)
+        if pos is None or not pos:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = pos
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out[f"self.{t.attr}"] = pos
+    return out
+
+
+def _names_read(node: ast.AST) -> set[str]:
+    return {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _names_bound(stmt: ast.stmt) -> set[str]:
+    bound: set[str] = set()
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            bound.add(n.id)
+    return bound
+
+
+class _BlockScanner:
+    """Scan each statement block for donate-then-read sequences."""
+
+    def __init__(self, pf: ProjectFile, donators: dict[str, tuple[int, ...]]):
+        self.pf = pf
+        self.donators = donators
+        self.findings: list[Finding] = []
+
+    def scan_body(self, body: list[ast.stmt]) -> None:
+        for i, stmt in enumerate(body):
+            for call in self._calls_in(stmt):
+                key = self._donator_key(call)
+                if key is None:
+                    continue
+                positions = self.donators[key]
+                donated_names = {
+                    call.args[p].id
+                    for p in positions
+                    if p < len(call.args) and isinstance(call.args[p], ast.Name)
+                }
+                # rebinding by the donating statement itself is the idiom
+                donated_names -= _names_bound(stmt)
+                if donated_names:
+                    self._scan_tail(body[i + 1:], donated_names, key)
+            # recurse into nested blocks — but not nested defs/classes,
+            # which the top-level walk visits as their own scopes
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                    self.scan_body(sub)
+            for h in getattr(stmt, "handlers", []) or []:
+                self.scan_body(h.body)
+
+    def _calls_in(self, stmt: ast.stmt):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def _donator_key(self, call: ast.Call) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.donators:
+            return f.id
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and f"self.{f.attr}" in self.donators
+        ):
+            return f"self.{f.attr}"
+        return None
+
+    def _scan_tail(
+        self, tail: list[ast.stmt], names: set[str], fn_key: str
+    ) -> None:
+        live = set(names)
+        for stmt in tail:
+            if not live:
+                return
+            # reads anywhere in the statement fire first (a = x + 1 both
+            # reads x and binds a)
+            read = _names_read(stmt) & live
+            for name in sorted(read):
+                self.findings.append(Finding(
+                    path=self.pf.path,
+                    line=stmt.lineno,
+                    col=stmt.col_offset,
+                    code="DA501",
+                    message=(
+                        f"`{name}` was donated to `{fn_key}` (donate_argnums) "
+                        "and is read after the dispatch — its buffer now "
+                        "belongs to XLA; reorder the read or drop the "
+                        "donation"
+                    ),
+                ))
+            live -= read  # one report per donated name
+            live -= _names_bound(stmt)
+
+
+def analyze_file(pf: ProjectFile) -> list[Finding]:
+    if not pf.path.startswith("fedrec_tpu/"):
+        return []
+    donators = _collect_donators(pf.tree)
+    if not donators:
+        return []
+    scanner = _BlockScanner(pf, donators)
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            scanner.scan_body(node.body)
+    return scanner.findings

@@ -1,0 +1,206 @@
+"""The ``fedrec-lint`` engine: run analyzers, apply suppressions + baseline.
+
+Composition contract (docs/ANALYSIS.md "adding an analyzer"):
+
+* a **per-file analyzer** exports ``analyze_file(pf: ProjectFile) ->
+  list[Finding]`` and is listed in :data:`FILE_ANALYZERS`;
+* a **project analyzer** exports ``analyze_project(project: Project) ->
+  list[Finding]`` and is listed in :data:`PROJECT_ANALYZERS`;
+* codes are registered via :func:`core.register_codes` at import time.
+
+The engine owns everything cross-cutting: inline suppressions, the
+baseline file, ``--select``/``--ignore`` filtering, and stable ordering.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+from . import (
+    config_contract,
+    donation,
+    feature_matrix,
+    generic,
+    metric_contract,
+    trace_safety,
+)
+from .core import (
+    CODE_CATALOG,
+    DEFAULT_SCAN_ROOTS,
+    Finding,
+    Project,
+    finding_fingerprint,
+    load_baseline,
+    normalize_scan_roots,
+)
+
+FILE_ANALYZERS = {
+    "trace_safety": trace_safety.analyze_file,
+    "donation": donation.analyze_file,
+    "generic": generic.analyze_file,
+}
+PROJECT_ANALYZERS = {
+    "config_contract": config_contract.analyze_project,
+    "metric_contract": metric_contract.analyze_project,
+    "feature_matrix": feature_matrix.analyze_project,
+}
+
+DEFAULT_BASELINE = "fedrec_tpu/analysis/lint_baseline.json"
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]                 # new findings (reported)
+    suppressed: int = 0
+    baselined: int = 0
+    files_scanned: int = 0
+    stale_baseline: list[str] = field(default_factory=list)
+    all_fingerprints: list[str] = field(default_factory=list)
+    # True when ANY filter narrowed the run (paths, select/ignore,
+    # analyzers) — THE definition consumers use: --write-baseline refuses
+    # filtered results, and stale_baseline is cleared on them (a filtered
+    # run reports every deselected entry as "stale")
+    filtered: bool = False
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def _code_selected(
+    code: str, select: set[str] | None, ignore: set[str]
+) -> bool:
+    def match(spec: str) -> bool:
+        return code == spec or code.startswith(spec)
+
+    if any(match(s) for s in ignore):
+        return False
+    if select is not None:
+        return any(match(s) for s in select)
+    return True
+
+
+def _under(path: str, roots: Iterable[str]) -> bool:
+    return any(path == r or path.startswith(r.rstrip("/") + "/") for r in roots)
+
+
+def run_lint(
+    root: str | Path,
+    scan_roots: Iterable[str] = DEFAULT_SCAN_ROOTS,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] = (),
+    baseline_path: str | Path | None = DEFAULT_BASELINE,
+    analyzers: Iterable[str] | None = None,
+) -> LintResult:
+    """Run the lint engine over ``root``.  ``select``/``ignore`` take full
+    codes or prefixes (``TS``, ``CC2``).  ``baseline_path`` (relative to
+    root) of None disables the baseline.
+
+    ``scan_roots`` narrower than the default is a REPORTING filter, not an
+    analysis scope: the project-level analyzers always see the full
+    default tree (a partial view would turn every unseen guard/flag into
+    a false FM402/CC finding), and findings are then restricted to paths
+    under the requested roots.
+    """
+    root = Path(root).resolve()
+    scan_roots = normalize_scan_roots(root, scan_roots)
+    partial = set(scan_roots) != set(DEFAULT_SCAN_ROOTS)
+    if partial:
+        # explicit roots must exist: a typo'd path silently matching
+        # nothing would filter the run down to a false-clean exit 0.
+        # (DEFAULT roots may legitimately be absent — miniature trees have
+        # no benchmarks/ — so only the explicit case is strict.)
+        for r in scan_roots:
+            if not (root / r).exists():
+                raise ValueError(
+                    f"scan root {r!r} does not exist under {root} — "
+                    "a typo here would lint nothing and report clean"
+                )
+    load_roots = (
+        tuple(dict.fromkeys((*DEFAULT_SCAN_ROOTS, *scan_roots)))
+        if partial else scan_roots
+    )
+    project = Project.load(root, load_roots)
+    select_set = set(select) if select is not None else None
+    ignore_set = set(ignore)
+    wanted = set(analyzers) if analyzers is not None else (
+        set(FILE_ANALYZERS) | set(PROJECT_ANALYZERS)
+    )
+    unknown = wanted - set(FILE_ANALYZERS) - set(PROJECT_ANALYZERS)
+    if unknown:
+        raise ValueError(f"unknown analyzers: {sorted(unknown)}")
+
+    raw: list[Finding] = []
+    for name, fn in FILE_ANALYZERS.items():
+        if name not in wanted:
+            continue
+        for pf in project.files:
+            if partial and not _under(pf.path, scan_roots):
+                continue
+            raw.extend(fn(pf))
+    for name, fn in PROJECT_ANALYZERS.items():
+        if name in wanted:
+            raw.extend(fn(project))
+
+    raw = [f for f in raw if _code_selected(f.code, select_set, ignore_set)]
+    if partial:
+        raw = [f for f in raw if _under(f.path, scan_roots)]
+
+    # suppressions: line/file comments in the flagged file
+    suppressed = 0
+    kept: list[Finding] = []
+    files_by_path = {pf.path: pf for pf in project.files}
+    for f in sorted(set(raw)):
+        pf = files_by_path.get(f.path)
+        if pf is not None and pf.suppressions.covers(f):
+            suppressed += 1
+            continue
+        kept.append(f)
+
+    # fingerprints are always computed (they feed --write-baseline even on
+    # a baseline-less run); the baseline filter applies when a file is set
+    baselined = 0
+    stale: list[str] = []
+    all_fps: list[str] = []
+    seen_fps: set[str] = set()
+    fingerprinted: list[tuple[Finding, str]] = []
+    for f in kept:
+        pf = files_by_path.get(f.path)
+        lines = pf.lines if pf is not None else []
+        fp = finding_fingerprint(f, lines)
+        all_fps.append(fp)
+        seen_fps.add(fp)
+        fingerprinted.append((f, fp))
+    filtered = (
+        partial
+        or select_set is not None
+        or bool(ignore_set)
+        or analyzers is not None
+    )
+    if baseline_path is not None:
+        known = load_baseline(root / baseline_path)
+        kept = [f for f, fp in fingerprinted if fp not in known]
+        baselined = len(fingerprinted) - len(kept)
+        if not filtered:
+            stale = sorted(known - seen_fps)
+
+    return LintResult(
+        findings=sorted(kept),
+        suppressed=suppressed,
+        baselined=baselined,
+        files_scanned=len(project.files),
+        stale_baseline=stale,
+        all_fingerprints=all_fps,
+        filtered=filtered,
+    )
+
+
+def codes_table() -> list[tuple[str, str, str]]:
+    """(code, analyzer, description) rows, sorted — the ``--list-codes``
+    surface and the docs/ANALYSIS.md catalogue source."""
+    return sorted(
+        (code, analyzer, desc)
+        for code, (desc, analyzer) in CODE_CATALOG.items()
+    )

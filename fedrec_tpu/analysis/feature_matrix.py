@@ -1,0 +1,247 @@
+"""FM4xx — the feature-compatibility matrix, machine-checked.
+
+The repo's incompatible feature combinations (``fuse_hot_path`` × DP-SGD,
+``seq_shards>1`` × chaos, non-decodable codecs × numpy robust reduce, ...)
+are enforced by fail-fast guards scattered through ``train/step.py``,
+``train/trainer.py``, ``models/``, ``parallel/`` and the CLIs.  Before
+this analyzer they were ALSO documented by hand, in three different docs
+— the classic three-copies drift.  Now ``analysis/feature_matrix.toml``
+is the single declared source:
+
+* each ``[[rules]]`` entry names the feature pair, its status
+  (``incompatible`` / ``requires``), the guard file(s) and a regex the
+  guard's raise message must match, and the one-line why;
+* the **docs table** (docs/ANALYSIS.md between the
+  ``FEATURE_MATRIX_BEGIN/END`` markers) is GENERATED from the toml
+  (``fedrec-lint --write-feature-table``), never hand-edited.
+
+Codes:
+
+* **FM401** — a feature-combination guard in code (a ``ValueError`` /
+  ``NotImplementedError`` whose message reads like a compatibility
+  contract) that no toml rule claims: the matrix is missing a row.
+* **FM402** — a toml rule whose regex matches no raise in its guard files:
+  the guard was removed/reworded and the matrix is stale.
+* **FM403** — the generated docs table does not match the toml (drift;
+  run ``fedrec-lint --write-feature-table``).
+
+Guard-candidate detection is deliberately message-based: the guard's
+raise message IS the operator contract, so a guard whose message doesn't
+state the incompatibility is a guard worth rewording.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from .core import Finding, Project, dotted_name, literal_str, register_codes
+
+try:  # Python 3.11+
+    import tomllib as _toml
+except ImportError:  # pragma: no cover - 3.10 rig
+    import tomli as _toml  # type: ignore[no-redef]
+
+CODES = {
+    "FM401": "feature-combination guard in code not declared in feature_matrix.toml",
+    "FM402": "feature_matrix.toml rule with no matching guard in code (stale)",
+    "FM403": "generated feature-compatibility docs table drifted from the toml",
+}
+register_codes("feature_matrix", CODES)
+
+MATRIX_PATH = "fedrec_tpu/analysis/feature_matrix.toml"
+DOCS_PATH = "docs/ANALYSIS.md"
+TABLE_BEGIN = "<!-- FEATURE_MATRIX_BEGIN (generated from analysis/feature_matrix.toml — edit the toml, then `fedrec-lint --write-feature-table`) -->"
+TABLE_END = "<!-- FEATURE_MATRIX_END -->"
+
+GUARD_EXCEPTIONS = {"ValueError", "NotImplementedError"}
+# unconditional markers: the message states a combination contract outright
+CANDIDATE_MARKERS = (
+    "not supported",
+    "not combinable",
+    "cannot be combined",
+    "cannot run under",
+    "incompatible",
+)
+# conditional markers: common words, only a contract when a dotted flag is
+# also named in the message
+CONDITIONAL_MARKERS = ("requires", "needs", "assumes")
+FLAG_TOKEN_RE = re.compile(
+    r"\b(data|model|optim|fed|privacy|train|obs|chaos)\.[a-z_]"
+)
+
+
+@dataclass(frozen=True)
+class GuardFact:
+    path: str
+    line: int
+    message: str          # literal text, f-string holes as '*'
+
+    @property
+    def is_candidate(self) -> bool:
+        low = self.message.lower()
+        if any(m in low for m in CANDIDATE_MARKERS):
+            return True
+        return any(m in low for m in CONDITIONAL_MARKERS) and bool(
+            FLAG_TOKEN_RE.search(self.message)
+        )
+
+
+@dataclass
+class Rule:
+    id: str
+    feature: str
+    other: str
+    status: str           # "incompatible" | "requires"
+    guard_files: list[str]
+    guard_pattern: str
+    why: str
+
+    def matches(self, fact: GuardFact) -> bool:
+        if fact.path not in self.guard_files:
+            return False
+        return re.search(self.guard_pattern, fact.message) is not None
+
+
+def collect_guard_facts(project: Project) -> list[GuardFact]:
+    facts: list[GuardFact] = []
+    for pf in project.files:
+        if not pf.path.startswith("fedrec_tpu/") or pf.path.startswith(
+            "fedrec_tpu/analysis/"
+        ):
+            continue
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Raise) and isinstance(node.exc, ast.Call)):
+                continue
+            exc_name = dotted_name(node.exc.func).split(".")[-1]
+            if exc_name not in GUARD_EXCEPTIONS or not node.exc.args:
+                continue
+            msg = literal_str(node.exc.args[0])
+            if msg is None:
+                continue
+            facts.append(GuardFact(path=pf.path, line=node.lineno, message=msg))
+    return facts
+
+
+def load_rules(root: Path) -> list[Rule] | None:
+    p = root / MATRIX_PATH
+    if not p.exists():
+        return None
+    data = _toml.loads(p.read_text())
+    rules = []
+    for raw in data.get("rules", []):
+        rules.append(Rule(
+            id=raw["id"],
+            feature=raw["feature"],
+            other=raw["other"],
+            status=raw.get("status", "incompatible"),
+            guard_files=list(raw["guard_files"]),
+            guard_pattern=raw["guard_pattern"],
+            why=raw.get("why", ""),
+        ))
+    return rules
+
+
+# ------------------------------------------------------------- docs table
+
+
+def render_table(rules: list[Rule]) -> str:
+    """The generated compatibility table, sorted by rule id for stability."""
+    lines = [
+        TABLE_BEGIN,
+        "",
+        "| feature | combined with / requirement | status | enforced at | why |",
+        "|---|---|---|---|---|",
+    ]
+    for r in sorted(rules, key=lambda r: r.id):
+        status = "✗ incompatible" if r.status == "incompatible" else "→ requires"
+        guards = ", ".join(f"`{g}`" for g in r.guard_files)
+        lines.append(
+            f"| `{r.feature}` | `{r.other}` | {status} | {guards} | {r.why} |"
+        )
+    lines += ["", TABLE_END]
+    return "\n".join(lines)
+
+
+def _find_table_region(text: str) -> tuple[int, int] | None:
+    begin = text.find(TABLE_BEGIN)
+    end = text.find(TABLE_END)
+    if begin == -1 or end == -1 or end < begin:
+        return None
+    return begin, end + len(TABLE_END)
+
+
+def write_docs_table(root: Path) -> bool:
+    """Regenerate the docs table in place; returns True if the file changed."""
+    rules = load_rules(root)
+    if rules is None:
+        raise FileNotFoundError(MATRIX_PATH)
+    doc = root / DOCS_PATH
+    rendered = render_table(rules)
+    text = doc.read_text() if doc.exists() else ""
+    region = _find_table_region(text)
+    if region is None:
+        new = text.rstrip() + "\n\n" + rendered + "\n"
+    else:
+        new = text[: region[0]] + rendered + text[region[1]:]
+    if new != text:
+        doc.write_text(new)
+        return True
+    return False
+
+
+# ------------------------------------------------------------------ driver
+
+
+def analyze_project(project: Project) -> list[Finding]:
+    rules = load_rules(project.root)
+    if rules is None:
+        return [Finding(
+            path=MATRIX_PATH, line=0, col=0, code="FM402",
+            message="analysis/feature_matrix.toml is missing — the "
+                    "feature-compatibility matrix cannot be checked",
+        )]
+    facts = collect_guard_facts(project)
+    findings: list[Finding] = []
+
+    for fact in facts:
+        if not fact.is_candidate:
+            continue
+        if not any(r.matches(fact) for r in rules):
+            findings.append(Finding(
+                path=fact.path, line=fact.line, col=0, code="FM401",
+                message=(
+                    "feature-combination guard not declared in "
+                    f"{MATRIX_PATH} (message: "
+                    f"{fact.message[:80]!r}...) — add a [[rules]] entry "
+                    "so the docs table stays complete"
+                ),
+            ))
+    for rule in rules:
+        if not any(rule.matches(f) for f in facts):
+            findings.append(Finding(
+                path=MATRIX_PATH, line=0, col=0, code="FM402",
+                message=(
+                    f"rule {rule.id!r} matches no raise in "
+                    f"{rule.guard_files} — the guard moved or was "
+                    "reworded; update the rule (or delete it if the "
+                    "combination became legal)"
+                ),
+            ))
+
+    doc = project.root / DOCS_PATH
+    text = doc.read_text() if doc.exists() else ""
+    region = _find_table_region(text)
+    current = text[region[0]: region[1]] if region else None
+    if current != render_table(rules):
+        findings.append(Finding(
+            path=DOCS_PATH, line=0, col=0, code="FM403",
+            message=(
+                "feature-compatibility table is stale (or missing) — run "
+                "`fedrec-lint --write-feature-table` to regenerate it "
+                "from analysis/feature_matrix.toml"
+            ),
+        ))
+    return findings

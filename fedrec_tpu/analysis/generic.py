@@ -1,0 +1,162 @@
+"""GL9xx — generic hygiene layer (pyflakes-subset, stdlib-only).
+
+The environment this repo targets does not ship ruff or pyflakes, so the
+handful of generic rules worth gating on are implemented here and run as
+part of ``fedrec-lint``.  When ruff IS installed, ``scripts/lint.sh``
+additionally runs the ``[tool.ruff]`` rule subset from pyproject.toml —
+the two layers agree by construction because the builtin rules are a
+strict subset of the configured ruff ones (F401/F601/F541 equivalents).
+
+Codes:
+
+* **GL901** — unused import (module or function scope).  ``__init__.py``
+  re-export surfaces are exempt, as are imports under
+  ``try:/except ImportError`` compat shims, ``if TYPE_CHECKING:`` blocks,
+  and lines carrying a ``# noqa`` marker.
+* **GL902** — duplicate literal key in a dict display (the last one wins
+  silently — always a bug or a merge scar).
+* **GL903** — f-string with no placeholders (usually a forgotten ``f`` on
+  the NEXT string, or a stray ``f`` that will confuse a future editor).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ProjectFile, register_codes
+
+CODES = {
+    "GL901": "unused import",
+    "GL902": "duplicate literal key in dict display",
+    "GL903": "f-string without placeholders",
+}
+register_codes("generic", CODES)
+
+_NOQA_MARKERS = ("# noqa", "#noqa")
+
+
+def _binding_names(node: ast.Import | ast.ImportFrom) -> list[str]:
+    names = []
+    for alias in node.names:
+        if alias.name == "*":
+            continue
+        if alias.asname:
+            names.append(alias.asname)
+        else:
+            names.append(alias.name.split(".")[0])
+    return names
+
+
+def _in_compat_block(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Try):
+            for h in cur.handlers:
+                t = h.type
+                names = []
+                if isinstance(t, ast.Name):
+                    names = [t.id]
+                elif isinstance(t, ast.Tuple):
+                    names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+                if any(n in ("ImportError", "ModuleNotFoundError") for n in names):
+                    return True
+        if isinstance(cur, ast.If):
+            test = cur.test
+            t_name = test.id if isinstance(test, ast.Name) else (
+                test.attr if isinstance(test, ast.Attribute) else ""
+            )
+            if t_name == "TYPE_CHECKING":
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+def analyze_file(pf: ProjectFile) -> list[Finding]:
+    findings: list[Finding] = []
+    if not pf.path.endswith("__init__.py"):
+        findings.extend(_unused_imports(pf))
+    findings.extend(_dict_and_fstring_checks(pf))
+    return findings
+
+
+def _unused_imports(pf: ProjectFile) -> list[Finding]:
+    parents: dict[ast.AST, ast.AST] = {}
+    imports: list[tuple[ast.stmt, str]] = []
+    used: set[str] = set()
+    exported: set[str] = set()
+
+    for node in ast.walk(pf.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            if _in_compat_block(node, parents):
+                continue
+            line = pf.lines[node.lineno - 1] if node.lineno <= len(pf.lines) else ""
+            if any(m in line for m in _NOQA_MARKERS):
+                continue
+            for name in _binding_names(node):
+                imports.append((node, name))
+        elif isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the chain root is a Name node, already walked
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # __all__ entries and string annotations keep imports alive
+            exported.add(node.value)
+
+    findings = []
+    for node, name in imports:
+        if name in used or name in exported:
+            continue
+        findings.append(Finding(
+            path=pf.path, line=node.lineno, col=node.col_offset,
+            code="GL901",
+            message=f"`{name}` is imported but never used",
+        ))
+    return findings
+
+
+def _dict_and_fstring_checks(pf: ProjectFile) -> list[Finding]:
+    findings: list[Finding] = []
+    # format specs (`{x:.4f}`) are themselves JoinedStr nodes with no
+    # placeholders — collect them so GL903 never fires on one
+    format_specs = {
+        id(n.format_spec)
+        for n in ast.walk(pf.tree)
+        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+    }
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Dict):
+            seen: dict[object, int] = {}
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, (str, int, float, bool, bytes)
+                ):
+                    key = (type(k.value).__name__, k.value)
+                    if key in seen:
+                        findings.append(Finding(
+                            path=pf.path, line=k.lineno, col=k.col_offset,
+                            code="GL902",
+                            message=(
+                                f"duplicate dict key {k.value!r} (first at "
+                                f"line {seen[key]}) — the later value "
+                                "silently wins"
+                            ),
+                        ))
+                    else:
+                        seen[key] = k.lineno
+        elif isinstance(node, ast.JoinedStr):
+            if id(node) not in format_specs and not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                findings.append(Finding(
+                    path=pf.path, line=node.lineno, col=node.col_offset,
+                    code="GL903",
+                    message="f-string has no placeholders — drop the `f` "
+                            "(or it hides a missing `{}`)",
+                ))
+    return findings

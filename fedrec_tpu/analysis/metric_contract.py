@@ -1,0 +1,161 @@
+"""MC3xx — the metric contract: registered ⟷ catalogued ⟷ exposable.
+
+The obs registry (``fedrec_tpu.obs.registry``) already fails fast at
+RUNTIME when one process re-registers a name as a different kind — but two
+subsystems that never run in the same process (trainer vs serving) can
+still ship conflicting kinds, and nothing at runtime notices a metric that
+was renamed in code but not in docs/OBSERVABILITY.md.  This analyzer makes
+those contracts static:
+
+* **MC301** — a metric name registered in code that the
+  docs/OBSERVABILITY.md catalogue does not list (operators grep the
+  catalogue; an uncatalogued metric is invisible).
+* **MC302** — a metric name that is not cleanly Prometheus-exposable:
+  after ``sanitize_prom_name`` it must be a valid metric name AND the raw
+  name must stick to ``[a-zA-Z0-9_.:@]`` so two distinct dotted names can
+  never sanitize into the same exposition name.
+* **MC303** — one name registered with conflicting kinds across call sites
+  (counter here, gauge there — the cross-process shadowing the runtime
+  check cannot see).
+
+Registration sites are ``.counter("name", ...)`` / ``.gauge`` /
+``.histogram`` calls with a literal first argument, anywhere in the
+package/benchmarks.  Dynamic names (f-strings with holes, variables) are
+skipped — the MetricLogger's numeric-gauge mirror is the documented
+dynamic surface and is catalogued as such.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from .core import Finding, Project, register_codes
+
+CODES = {
+    "MC301": "metric registered in code but absent from docs/OBSERVABILITY.md",
+    "MC302": "metric name not cleanly Prometheus-sanitizable",
+    "MC303": "metric name registered with conflicting kinds across call sites",
+}
+register_codes("metric_contract", CODES)
+
+CATALOG_DOC = "docs/OBSERVABILITY.md"
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+# raw names must stay inside this set so sanitize_prom_name is injective
+# on the names the repo actually uses ('@' sanitizes to '_' but only the
+# eval\@k family uses it, documented as such)
+_RAW_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_.:@]*$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+@dataclass(frozen=True)
+class Registration:
+    name: str
+    kind: str
+    path: str
+    line: int
+    col: int
+
+
+def collect_registrations(project: Project) -> list[Registration]:
+    regs: list[Registration] = []
+    for pf in project.files:
+        if pf.path == "fedrec_tpu/obs/registry.py":
+            continue  # the registry's own plumbing, not a call site
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in REGISTER_METHODS
+            ):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ):
+                continue  # dynamic name: out of static scope
+            regs.append(Registration(
+                name=first.value,
+                kind=func.attr,
+                path=pf.path,
+                line=node.lineno,
+                col=node.col_offset,
+            ))
+    return regs
+
+
+def catalogued_names(root: Path) -> set[str] | None:
+    """Backticked metric tokens from the OBSERVABILITY.md tables; None when
+    the doc is missing (each registration then reports MC301)."""
+    doc = root / CATALOG_DOC
+    if not doc.exists():
+        return None
+    names: set[str] = set()
+    for line in doc.read_text().splitlines():
+        for m in _BACKTICK_RE.finditer(line):
+            for tok in re.split(r"[,\s/]+", m.group(1)):
+                tok = tok.strip()
+                tok = re.sub(r"\{[^}]*\}?$", "", tok)   # strip {label=...}
+                tok = tok.strip("`*.,:;()[]")
+                if tok and _RAW_NAME_RE.match(tok):
+                    names.add(tok)
+    return names
+
+
+def analyze_project(project: Project) -> list[Finding]:
+    regs = collect_registrations(project)
+    catalog = catalogued_names(project.root)
+    findings: list[Finding] = []
+
+    kinds: dict[str, dict[str, Registration]] = {}
+    for reg in regs:
+        kinds.setdefault(reg.name, {}).setdefault(reg.kind, reg)
+
+    reported_301: set[str] = set()
+    for reg in regs:
+        if not _RAW_NAME_RE.match(reg.name):
+            findings.append(Finding(
+                path=reg.path, line=reg.line, col=reg.col, code="MC302",
+                message=(
+                    f"metric name {reg.name!r} is not cleanly "
+                    "Prometheus-sanitizable (stick to [a-zA-Z0-9_.:@], "
+                    "leading letter/underscore)"
+                ),
+            ))
+        if (catalog is None or reg.name not in catalog) and (
+            reg.name not in reported_301
+        ):
+            reported_301.add(reg.name)
+            findings.append(Finding(
+                path=reg.path, line=reg.line, col=reg.col, code="MC301",
+                message=(
+                    f"metric `{reg.name}` ({reg.kind}) is not catalogued "
+                    f"in {CATALOG_DOC} — add a table row (name, kind, "
+                    "meaning) or rename to an existing entry"
+                ),
+            ))
+    for name, by_kind in sorted(kinds.items()):
+        if len(by_kind) > 1:
+            sites = sorted(by_kind.values(), key=lambda r: (r.path, r.line))
+            desc = ", ".join(
+                f"{r.kind} at {r.path}:{r.line}" for r in sites
+            )
+            first = sites[0]
+            findings.append(Finding(
+                path=first.path, line=first.line, col=first.col,
+                code="MC303",
+                message=(
+                    f"metric `{name}` registered with conflicting kinds "
+                    f"({desc}) — the registry will fail fast only when "
+                    "both call sites share a process"
+                ),
+            ))
+    return findings

@@ -1,0 +1,466 @@
+"""TS1xx — trace-safety inside jitted scopes.
+
+FedJAX-style stacks live or die by keeping host Python out of traced code:
+a ``float(loss)`` inside a jitted step is a blocking device sync per call,
+``time.time()`` bakes a trace-time constant into the compiled program, and
+a Python ``if`` on a tracer raises ``TracerBoolConversionError`` only on
+the path that actually executes.  This analyzer finds **traced scopes**
+structurally — functions passed to / decorated with ``jax.jit``,
+``shard_map``, ``lax.scan``/``map``/``cond``/``while_loop``, ``vmap``,
+``grad``, ``jax.checkpoint``, ``custom_vjp``/``defvjp`` or
+``pl.pallas_call``, plus everything nested inside one — and then runs a
+lightweight intra-function taint pass:
+
+* parameters are assumed tracer-valued (minus ``self``/``cfg``/``config``/
+  ``mesh``, the conventional static closures);
+* anything assigned from a tainted expression or a ``jnp.``/``jax.``/
+  ``lax.`` call is tainted;
+* ``.shape``/``.ndim``/``.dtype``/``.size`` reads are STATIC under jit and
+  break the taint — ``int(x.shape[0])`` is idiomatic and never flagged.
+
+Codes:
+
+* **TS101** — ``float()``/``int()``/``bool()`` on a tracer-valued
+  expression (host sync / TracerBoolConversionError).
+* **TS102** — ``.item()``/``.tolist()``/``.block_until_ready()`` on a
+  tracer-valued expression (explicit host sync).
+* **TS103** — ``np.*`` call applied to a tracer-valued argument (silently
+  materializes the array on host; use ``jnp``).
+* **TS104** — ``time.*`` / stdlib ``random.*`` call inside a traced scope
+  (trace-time constant masquerading as a runtime value).  Only fires when
+  the module imports the STDLIB modules (``jax.random`` via other names is
+  untouched).
+* **TS105** — Python ``if``/``while`` on a tracer-valued test (heuristic;
+  use ``lax.cond``/``jnp.where`` or suppress where the value is provably
+  static).
+
+TS105 is the one deliberately-heuristic code: trace-time branching on
+static values is idiomatic in the step builders, so taint — not the mere
+presence of a branch — is what fires it, and a
+``# fedrec-lint: disable=TS105`` with a word of justification is the
+documented escape hatch for false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ProjectFile, dotted_name, register_codes
+
+CODES = {
+    "TS101": "float()/int()/bool() on a tracer value inside a jitted scope",
+    "TS102": ".item()/.tolist()/.block_until_ready() inside a jitted scope",
+    "TS103": "np.* applied to a tracer value inside a jitted scope",
+    "TS104": "time.*/random.* call inside a jitted scope",
+    "TS105": "Python if/while on a tracer-valued expression (heuristic)",
+}
+register_codes("trace_safety", CODES)
+
+# call targets whose function-valued arguments become traced scopes; matched
+# on the full dotted name or any '.'-boundary suffix (jax.lax.scan ~ lax.scan)
+TRACING_CALLS = {
+    "jax.jit", "jit", "pjit",
+    "pallas_call",                      # pl.pallas_call / pltpu variants
+    "lax.scan", "lax.map", "lax.cond", "lax.switch",
+    "lax.while_loop", "lax.fori_loop", "lax.associative_scan",
+    "jax.vmap", "vmap", "jax.pmap",
+    "jax.grad", "jax.value_and_grad", "value_and_grad",
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "jax.custom_vjp", "custom_vjp", "jax.custom_jvp", "custom_jvp",
+    "shard_map",
+}
+
+# attribute-call registrations: f.defvjp(fwd, bwd) / f.defjvp(...)
+TRACING_METHOD_CALLS = {"defvjp", "defjvp", "def_fwd", "def_bwd"}
+
+UNTAINT_ATTRS = {"shape", "ndim", "dtype", "size"}
+STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "mesh", "hparams"}
+# params annotated with host-static types are trace-time constants by the
+# repo's own convention (robust_aggregate(method: str, trim_k: int, ...))
+STATIC_ANNOTATIONS = {"str", "bool", "int", "float"}
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "__array__"}
+SCALAR_COERCIONS = {"float", "int", "bool"}
+
+
+def _matches_tracing(dotted: str) -> bool:
+    if dotted in TRACING_CALLS:
+        return True
+    return any(dotted.endswith("." + s) for s in TRACING_CALLS)
+
+
+TRACED_SCOPE_MARK = "fedrec-lint: traced-scope"
+
+
+def _collect_traced_functions(
+    tree: ast.Module, lines: list[str] | None = None
+) -> set[ast.AST]:
+    """Function nodes that execute under a trace (see module docstring).
+
+    Besides the structural rules, a ``# fedrec-lint: traced-scope``
+    comment on the def line (or the line above) marks a function traced —
+    the opt-in for code only ever CALLED from jitted scopes in other
+    modules (fed/robust.py's in-graph aggregators), which no
+    single-module structural rule can see.
+    """
+    funcs: dict[str, list[ast.AST]] = {}
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+
+    traced: set[ast.AST] = set()
+
+    def mark_name(name: str) -> None:
+        for fn in funcs.get(name, []):
+            traced.add(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = dotted_name(target)
+                if _matches_tracing(dotted):
+                    traced.add(node)
+                # @partial(jax.jit, ...) — the wrapper is the first arg
+                if isinstance(dec, ast.Call) and dotted.endswith("partial"):
+                    if dec.args and _matches_tracing(dotted_name(dec.args[0])):
+                        traced.add(node)
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func)
+            is_tracing = _matches_tracing(dotted)
+            is_method_reg = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in TRACING_METHOD_CALLS
+            )
+            if not (is_tracing or is_method_reg):
+                continue
+            cands = list(node.args) + [kw.value for kw in node.keywords]
+            # partial(jax.jit, body, ...): skip the wrapper itself
+            if dotted.endswith("partial"):
+                cands = cands[1:]
+            for arg in cands:
+                if isinstance(arg, ast.Name):
+                    mark_name(arg.id)
+                elif isinstance(arg, (ast.FunctionDef, ast.Lambda)):
+                    traced.add(arg)
+
+    if lines:
+        for lst in funcs.values():
+            for fn in lst:
+                first = (
+                    fn.decorator_list[0].lineno
+                    if getattr(fn, "decorator_list", None)
+                    else fn.lineno
+                )
+                for lineno in (first, first - 1):
+                    if (
+                        1 <= lineno <= len(lines)
+                        and TRACED_SCOPE_MARK in lines[lineno - 1]
+                    ):
+                        traced.add(fn)
+
+    # nesting: every def inside a traced def is traced
+    def chain_traced(node: ast.AST) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if cur in traced:
+                return True
+            cur = parents.get(cur)
+        return False
+
+    for lst in funcs.values():
+        for fn in lst:
+            if fn not in traced and chain_traced(fn):
+                traced.add(fn)
+
+    # call-graph propagation: a module-local function CALLED from a traced
+    # scope executes under the same trace (local_step is never passed to
+    # jax.jit itself — sharded_step, which IS, calls it).  Fixpoint over
+    # name edges; cross-module callees are the traced-scope marker's job.
+    calls_by_fn: dict[ast.AST, set[str]] = {}
+    for lst in funcs.values():
+        for fn in lst:
+            called: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name):
+                        called.add(node.func.id)
+                    # function-VALUED args into a call made under trace run
+                    # under the same trace (_cohort_call(local_step, ...))
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Name) and arg.id in funcs:
+                            called.add(arg.id)
+            calls_by_fn[fn] = called
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for name in calls_by_fn.get(fn, ()):
+                for callee in funcs.get(name, []):
+                    if callee not in traced:
+                        traced.add(callee)
+                        changed = True
+    return traced
+
+
+class _TaintChecker:
+    """One traced function: seed taint, sweep statements, emit findings."""
+
+    def __init__(self, pf: ProjectFile, fn: ast.AST, flag_time: bool,
+                 flag_random: bool):
+        self.pf = pf
+        self.fn = fn
+        self.flag_time = flag_time
+        self.flag_random = flag_random
+        self.findings: list[Finding] = []
+        self.tainted: set[str] = set()
+        # names assigned from list/dict/set displays or comprehensions:
+        # their ELEMENTS may be tracers but their truthiness/emptiness is a
+        # static host property, so `if not leaves:` never fires TS105
+        self.containers: set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            args = fn.args
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                if a.arg in STATIC_PARAM_NAMES:
+                    continue
+                ann = getattr(a, "annotation", None)
+                if ann is not None and dotted_name(ann) in STATIC_ANNOTATIONS:
+                    continue
+                self.tainted.add(a.arg)
+
+    # ------------------------------------------------------------- taint
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in UNTAINT_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func)
+            # type-level / shape-level builtins are static under jit
+            if dotted in ("isinstance", "len", "type", "hasattr"):
+                return False
+            root = dotted.split(".", 1)[0]
+            if root in ("jnp", "lax", "jax"):
+                return True
+            # method call on a tainted receiver (batch.sum()) stays tainted
+            if isinstance(node.func, ast.Attribute) and self.is_tainted(
+                node.func.value
+            ):
+                return True
+            return any(
+                self.is_tainted(a)
+                for a in list(node.args) + [kw.value for kw in node.keywords]
+            )
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value) or self.is_tainted(node.slice)
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            # identity tests (`x is None`) are host-level structure checks,
+            # never tracer-valued — evaluated once at trace time
+            return False
+        if isinstance(node, (ast.Constant, ast.Lambda)):
+            return False
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    def _taint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_target(elt)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+
+    # ------------------------------------------------------------- sweep
+    def run(self) -> list[Finding]:
+        body = getattr(self.fn, "body", [])
+        if isinstance(body, ast.expr):  # lambda: body is a single expression
+            self._check_expr(body)
+            return self.findings
+        # two passes: loop bodies can read names assigned later in the loop
+        for _ in range(2):
+            self.findings = []
+            for stmt in body:
+                self._visit_stmt(stmt)
+        return self.findings
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are analyzed as their own traced scopes
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self._check_expr(value)
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                if isinstance(value, (
+                    ast.List, ast.ListComp, ast.Dict, ast.DictComp,
+                    ast.Set, ast.SetComp,
+                )):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.containers.add(t.id)
+                if self.is_tainted(value):
+                    for t in targets:
+                        self._taint_target(t)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_expr(stmt.test)
+            if self.is_tainted(stmt.test) and not self._container_truthiness(
+                stmt.test
+            ):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self._emit(
+                    stmt, "TS105",
+                    f"Python `{kind}` on a tracer-valued expression — "
+                    "traced code sees only one branch; use lax.cond / "
+                    "jnp.where (or suppress if provably static)",
+                )
+            for s in stmt.body + getattr(stmt, "orelse", []):
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            self._check_expr(stmt.iter)
+            if self.is_tainted(stmt.iter):
+                self._taint_target(stmt.target)
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            for s in stmt.body:
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._visit_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._visit_stmt(s)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_expr(stmt.value)
+            return
+        # Raise/Pass/Break/...: check any embedded expressions generically
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_expr(child)
+
+    def _container_truthiness(self, test: ast.expr) -> bool:
+        """`if leaves:` / `if not leaves:` on a known container name — its
+        emptiness is static even when its elements are tracers."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test = test.operand
+        return isinstance(test, ast.Name) and test.id in self.containers
+
+    # ------------------------------------------------------------ checks
+    def _check_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in SCALAR_COERCIONS
+                and node.args
+                and self.is_tainted(node.args[0])
+            ):
+                self._emit(
+                    node, "TS101",
+                    f"`{node.func.id}()` on a tracer value forces a host "
+                    "sync (or TracerBoolConversionError) inside a jitted "
+                    "scope",
+                )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+                and self.is_tainted(node.func.value)
+            ):
+                self._emit(
+                    node, "TS102",
+                    f"`.{node.func.attr}()` on a tracer value is an "
+                    "explicit host sync inside a jitted scope",
+                )
+            root = dotted.split(".", 1)[0]
+            if root in ("np", "numpy") and any(
+                self.is_tainted(a)
+                for a in list(node.args) + [kw.value for kw in node.keywords]
+            ):
+                self._emit(
+                    node, "TS103",
+                    f"`{dotted}` on a tracer value materializes it on host "
+                    "— use the jnp equivalent inside jitted scopes",
+                )
+            if (self.flag_time and dotted.startswith("time.")) or (
+                self.flag_random and dotted.startswith("random.")
+            ):
+                self._emit(
+                    node, "TS104",
+                    f"`{dotted}()` inside a jitted scope bakes a "
+                    "trace-time host value into the compiled program",
+                )
+
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(Finding(
+            path=self.pf.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            code=code,
+            message=message,
+        ))
+
+
+def _stdlib_import_flags(tree: ast.Module) -> tuple[bool, bool]:
+    """(imports stdlib time as `time`, imports stdlib random as `random`)."""
+    time_flag = random_flag = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if alias.name == "time" and name == "time":
+                    time_flag = True
+                if alias.name == "random" and name == "random":
+                    random_flag = True
+    return time_flag, random_flag
+
+
+def analyze_file(pf: ProjectFile) -> list[Finding]:
+    if not pf.path.startswith("fedrec_tpu/"):
+        return []
+    traced = _collect_traced_functions(pf.tree, pf.lines)
+    if not traced:
+        return []
+    flag_time, flag_random = _stdlib_import_flags(pf.tree)
+    findings: list[Finding] = []
+    for fn in traced:
+        checker = _TaintChecker(pf, fn, flag_time, flag_random)
+        findings.extend(checker.run())
+    # one finding per (line, code): the 2-pass sweep and nested walks can
+    # revisit the same node
+    seen: set[tuple] = set()
+    out = []
+    for f in sorted(findings):
+        key = (f.line, f.col, f.code)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

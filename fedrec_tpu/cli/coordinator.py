@@ -222,6 +222,11 @@ def main(argv: list[str] | None = None) -> int:
     cfg.fed.strategy = "param_avg"
     cfg.fed.local_epochs = args.local_epochs
     cfg.fed.num_clients = args.clients or len(jax.local_devices())
+    # record the data source IN the config (config.json provenance);
+    # --set data.* overrides below still win over the CLI flags
+    cfg.data.data_dir = args.data_dir
+    if args.synthetic:
+        cfg.data.dataset = "synthetic"
     cfg.apply_overrides(args.overrides)
 
     if cfg.fed.robust.method != "mean" and cfg.fed.dcn_compress != "none":
@@ -265,14 +270,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     apply_process_sharding(cfg, rt, args.server_trains)
 
-    if args.synthetic:
+    if cfg.data.dataset == "synthetic":
         from fedrec_tpu.cli.run import make_synthetic_from_args
 
         data = make_synthetic_from_args(args, cfg)
     else:
-        data = load_mind_artifacts(args.data_dir)
+        # "mind" and "adressa" share the artifact schema, one loader both
+        data = load_mind_artifacts(cfg.data.data_dir)
 
-    token_path = args.token_states or str(Path(args.data_dir) / "token_states.npy")
+    token_path = args.token_states or str(Path(cfg.data.data_dir) / "token_states.npy")
     if Path(token_path).exists():
         token_states = np.load(token_path)
     else:

@@ -1,0 +1,188 @@
+"""``fedrec-lint`` — the project-invariant static-analysis CLI.
+
+Usage patterns (docs/ANALYSIS.md §2):
+
+    fedrec-lint                          # lint the repo tree, exit 0/1
+    fedrec-lint --list-codes             # every code + one-line meaning
+    fedrec-lint --select TS,CC           # only these families
+    fedrec-lint --ignore TS105           # drop a code everywhere
+    fedrec-lint --format json            # machine-readable findings
+    fedrec-lint --write-baseline         # accept current findings
+    fedrec-lint --no-baseline            # report baselined findings too
+    fedrec-lint --write-feature-table    # regen the docs compat table
+    fedrec-lint --stats                  # scan/suppression counters
+
+Exit codes: 0 clean (suppressed/baselined findings are clean), 1 new
+findings, 2 usage/environment error — the same convention as fedrec-obs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from fedrec_tpu.analysis import (
+    DEFAULT_BASELINE,
+    codes_table,
+    run_lint,
+    write_baseline,
+    write_docs_table,
+)
+from fedrec_tpu.analysis.core import DEFAULT_SCAN_ROOTS
+
+
+def _find_root(start: Path) -> Path | None:
+    """Nearest ancestor that looks like the repo (has fedrec_tpu/config.py)."""
+    cur = start.resolve()
+    for cand in (cur, *cur.parents):
+        if (cand / "fedrec_tpu" / "config.py").exists():
+            return cand
+    return None
+
+
+def _split_codes(raw: str | None) -> list[str] | None:
+    if raw is None:
+        return None
+    return [c.strip() for c in raw.split(",") if c.strip()]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fedrec-lint",
+        description="project-invariant static analysis (docs/ANALYSIS.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="scan roots relative to the repo root "
+             f"(default: {' '.join(DEFAULT_SCAN_ROOTS)})",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: auto-detect)")
+    ap.add_argument("--select", default=None, metavar="CODES",
+                    help="comma list of codes/prefixes to keep (TS,CC201)")
+    ap.add_argument("--ignore", default=None, metavar="CODES",
+                    help="comma list of codes/prefixes to drop")
+    ap.add_argument("--analyzers", default=None, metavar="NAMES",
+                    help="comma list of analyzers to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file relative to root (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ap.add_argument("--write-feature-table", action="store_true",
+                    help="regenerate the docs feature-compatibility table "
+                         "from analysis/feature_matrix.toml and exit")
+    ap.add_argument("--list-codes", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="print scan/suppression/baseline counters")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        for code, analyzer, desc in codes_table():
+            print(f"{code}  [{analyzer}]  {desc}")
+        return 0
+
+    root = Path(args.root) if args.root else _find_root(Path.cwd())
+    if root is None or not (root / "fedrec_tpu" / "config.py").exists():
+        print(
+            "fedrec-lint: cannot find the repo root (no fedrec_tpu/config.py "
+            "above the working directory); pass --root", file=sys.stderr,
+        )
+        return 2
+
+    if args.write_feature_table:
+        try:
+            changed = write_docs_table(root)
+        except FileNotFoundError as e:
+            print(f"fedrec-lint: missing {e}", file=sys.stderr)
+            return 2
+        print(
+            "feature table "
+            + ("regenerated" if changed else "already up to date")
+            + f" in {root / 'docs/ANALYSIS.md'}"
+        )
+        return 0
+
+    # presence, not truthiness: --select "" would otherwise bypass the
+    # filtered-run guards while deselecting EVERY code
+    for flag, raw in (("--select", args.select), ("--ignore", args.ignore),
+                      ("--analyzers", args.analyzers)):
+        if raw is not None and not _split_codes(raw):
+            print(f"fedrec-lint: {flag} got an empty code list", file=sys.stderr)
+            return 2
+
+    scan_roots = args.paths or DEFAULT_SCAN_ROOTS
+    baseline = None if args.no_baseline else args.baseline
+    try:
+        result = run_lint(
+            root,
+            scan_roots=scan_roots,
+            select=_split_codes(args.select),
+            ignore=_split_codes(args.ignore) or (),
+            baseline_path=baseline,
+            analyzers=_split_codes(args.analyzers),
+        )
+    except ValueError as e:
+        print(f"fedrec-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # the engine's `filtered` flag is THE definition (normalized-root
+        # aware: spelling out the default roots is NOT a filter); a
+        # filtered run sees only a subset of findings, and writing it as
+        # the baseline would silently delete every deselected entry
+        if result.filtered:
+            print(
+                "fedrec-lint: --write-baseline requires an unfiltered run "
+                "(no paths/--select/--ignore/--analyzers) — the baseline "
+                "is the whole tree's accepted set, not a filtered view",
+                file=sys.stderr,
+            )
+            return 2
+        bp = root / args.baseline
+        write_baseline(bp, result.all_fingerprints)
+        print(
+            f"baseline written: {len(set(result.all_fingerprints))} "
+            f"fingerprints -> {bp}"
+        )
+        return 0
+
+    if args.format == "json":
+        payload = {
+            "findings": [
+                {
+                    "path": f.path, "line": f.line, "col": f.col,
+                    "code": f.code, "message": f.message,
+                }
+                for f in result.findings
+            ],
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "files_scanned": result.files_scanned,
+            "stale_baseline": result.stale_baseline,  # engine clears on filtered runs
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        if result.stale_baseline:  # engine clears this on filtered runs
+            print(
+                f"note: {len(result.stale_baseline)} baseline entries no "
+                "longer match any finding — run --write-baseline to prune",
+                file=sys.stderr,
+            )
+        if args.stats or result.findings:
+            print(
+                f"fedrec-lint: {len(result.findings)} finding(s), "
+                f"{result.suppressed} suppressed, {result.baselined} "
+                f"baselined, {result.files_scanned} files scanned",
+                file=sys.stderr,
+            )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

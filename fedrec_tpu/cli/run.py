@@ -97,14 +97,23 @@ def main(argv: list[str] | None = None) -> int:
         cfg.model.text_encoder_mode = "table" if args.mode == "decoupled" else "head"
     if args.obs_dir:
         cfg.obs.dir = args.obs_dir
+    # record the data source IN the config (snapshot config.json is the
+    # provenance record of what a run trained on); --set data.* overrides
+    # below still win over the CLI flags
+    cfg.data.data_dir = args.data_dir
+    if args.synthetic:
+        cfg.data.dataset = "synthetic"
     cfg.apply_overrides(args.overrides)
 
-    if args.synthetic:
+    if cfg.data.dataset == "synthetic":
         data = make_synthetic_from_args(args, cfg)
     else:
-        data = load_mind_artifacts(args.data_dir)
+        # "mind" and "adressa" share the artifact schema (the Adressa
+        # preprocessor writes the exact UserData/ layout), so one loader
+        # serves both dataset families
+        data = load_mind_artifacts(cfg.data.data_dir)
 
-    token_path = args.token_states or str(Path(args.data_dir) / "token_states.npy")
+    token_path = args.token_states or str(Path(cfg.data.data_dir) / "token_states.npy")
     if Path(token_path).exists():
         token_states = np.load(token_path)
     else:

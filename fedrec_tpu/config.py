@@ -461,7 +461,6 @@ class ChaosConfig:
 
 @dataclass
 class TrainConfig:
-    total_epochs: int = 10
     save_every: int = 1                # snapshot cadence (reference main.py argv)
     snapshot_dir: str = "snapshots"
     resume: bool = True                # auto-resume if snapshot exists (main.py:113-115)
@@ -501,7 +500,6 @@ class TrainConfig:
     # directly); the incumbent best survives resume. Off by default: the
     # round-cadence snapshots stay the only writers unless asked.
     keep_best: bool = False
-    log_every: int = 10
     seed: int = 42
     profile: bool = False              # jax.profiler trace around the hot loop
     wandb: bool = False
@@ -566,11 +564,22 @@ class ExperimentConfig:
         return self
 
 
+# flags deleted from the schema (fedrec-lint CC202 dead-flag findings).
+# from_dict tolerates them so snapshot config.json files written by older
+# runs keep loading; everything else unknown still fails fast.
+_REMOVED_KEYS = {
+    "train.total_epochs",   # the CLI positional writes fed.rounds directly
+    "train.log_every",      # never consulted; the Trainer logs every round
+}
+
+
 def _merge_dataclass(section: Any, values: dict[str, Any], path: str) -> None:
     """Set ``values`` onto a (possibly nested) config dataclass — the
     recursion behind ``from_dict``, so nested sections like ``obs.health``
     round-trip through to_dict/from_dict like every flat one."""
     for k, v in values.items():
+        if f"{path}.{k}" in _REMOVED_KEYS:
+            continue
         if not hasattr(section, k):
             raise KeyError(f"unknown config key: {path}.{k}")
         current = getattr(section, k)

@@ -35,7 +35,7 @@ live in the coordinator CLI, which reads the same config section.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np

@@ -64,6 +64,7 @@ def validate_robust_method(method: str) -> str:
 
 
 # --------------------------------------------------------------- in-graph
+# fedrec-lint: traced-scope — compiled into the shard_map round-end sync
 def _gather_cohort(x: jnp.ndarray, axis: Any) -> jnp.ndarray:
     """All clients' values as a leading (n, ...) dim, regardless of the
     client->chip packing. Cohort deployments sync over a (LOCAL_AXIS,
@@ -80,6 +81,7 @@ def _gather_cohort(x: jnp.ndarray, axis: Any) -> jnp.ndarray:
     return lax.all_gather(x, axis_name=axis, axis=0)
 
 
+# fedrec-lint: traced-scope — compiled into the shard_map round-end sync
 def _sorted_participants(gathered: jnp.ndarray, wmask: jnp.ndarray):
     """Sort a gathered (n, ...) leaf so finite participant values come
     first, ascending; everything else (dropouts, quarantined clients,
@@ -93,6 +95,7 @@ def _sorted_participants(gathered: jnp.ndarray, wmask: jnp.ndarray):
     return jnp.sort(vals, axis=0), jnp.sum(finite.astype(jnp.int32), axis=0)
 
 
+# fedrec-lint: traced-scope — compiled into the shard_map round-end sync
 def _trimmed_mean_leaf(gathered, wmask, trim_k: int):
     srt, m = _sorted_participants(gathered, wmask)
     pos = jnp.arange(srt.shape[0]).reshape((-1,) + (1,) * (srt.ndim - 1))
@@ -104,6 +107,7 @@ def _trimmed_mean_leaf(gathered, wmask, trim_k: int):
     return mean, m
 
 
+# fedrec-lint: traced-scope — compiled into the shard_map round-end sync
 def _median_leaf(gathered, wmask):
     srt, m = _sorted_participants(gathered, wmask)
     pos = jnp.arange(srt.shape[0]).reshape((-1,) + (1,) * (srt.ndim - 1))
@@ -114,6 +118,7 @@ def _median_leaf(gathered, wmask):
     return 0.5 * (lo_v + hi_v), m
 
 
+# fedrec-lint: traced-scope — compiled into the shard_map round-end sync
 def robust_aggregate(
     trees: Any,
     weight: jnp.ndarray,

@@ -372,7 +372,7 @@ def fused_gather_encode(
 
 
 # ================================================ fused history-attn + score
-def _score_block_b(block_b, hp, dp, qp, cp, itemsize, backward):
+def _score_block_b(block_b, hp, dp, qp, cp, itemsize, backward: bool):
     """Shrink the row-block so one program's block operands + f32
     temporaries stay inside a conservative VMEM budget (the same guard
     ``_pool_forward`` applies; the traced model below is the test-time
@@ -392,7 +392,7 @@ def _score_block_b(block_b, hp, dp, qp, cp, itemsize, backward):
 
 def _hist_forward_core(
     x_ref, mask_ref, wq_ref, bq_ref, wk_ref, bk_ref, wv_ref, bv_ref,
-    pw1_ref, pb1_ref, pw2_ref, *, nh, dh, h, keep_attn,
+    pw1_ref, pb1_ref, pw2_ref, *, nh: int, dh: int, h: int, keep_attn: bool,
 ):
     """Shared forward math for the fused score kernels (fwd + recompute in
     bwd): projections -> per-head masked attention -> additive pool.

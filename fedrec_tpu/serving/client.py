@@ -35,7 +35,6 @@ import asyncio
 import json
 import random
 import time
-from typing import Any
 
 
 class ServingUnavailable(ConnectionError):

@@ -1,0 +1,12 @@
+#!/bin/bash
+# The one local PR gate: static analysis, then tier-1.
+#
+#   scripts/check.sh         # or: make check
+#
+# Lint runs first because it is ~2 s against tier-1's ~14 min — a doc-drift
+# or dead-flag finding should not cost a full test run to discover.
+set -e
+cd "$(dirname "$0")/.."
+
+bash scripts/lint.sh
+bash scripts/t1.sh

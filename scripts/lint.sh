@@ -1,0 +1,30 @@
+#!/bin/bash
+# Static-analysis gate — fedrec-lint (the project-invariant analyzers,
+# docs/ANALYSIS.md) plus the generic layer.
+#
+#   scripts/lint.sh          # or: make lint
+#
+# The generic layer runs twice-over where possible: fedrec-lint's builtin
+# GL9xx rules always run (stdlib-only, every rig has them), and when ruff
+# is installed the [tool.ruff] subset from pyproject.toml runs too (a
+# superset-checker of the same pure-bug rules). Exit nonzero on any
+# finding from either.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "[lint] fedrec-lint (TS/CC/MC/FM/DA/GL, docs/ANALYSIS.md)"
+python -m fedrec_tpu.cli.lint --stats || rc=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "[lint] ruff ([tool.ruff] subset from pyproject.toml)"
+    ruff check fedrec_tpu benchmarks bench.py || rc=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    echo "[lint] ruff ([tool.ruff] subset from pyproject.toml)"
+    python -m ruff check fedrec_tpu benchmarks bench.py || rc=1
+else
+    echo "[lint] ruff not installed — builtin GL9xx rules covered the generic layer"
+fi
+
+exit $rc

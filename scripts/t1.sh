@@ -10,7 +10,7 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-timeout -k 10 1200 env JAX_PLATFORMS=cpu \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,18 @@
+"""Donation FALSE positives: the rebinding idiom and fresh buffers."""
+import jax
+
+
+def run(step, state, batches):
+    fn = jax.jit(step, donate_argnums=(0, 1))
+    for batch in batches:
+        # the donating statement REBINDS state — the idiom, never flagged
+        state, metrics = fn(state, batch)
+        # `batch` is rebound by the loop before any further read
+    return state, metrics
+
+
+def run_conditional(step, state, batch, donate):
+    # IfExp donation: only the always-donated intersection counts
+    fn = jax.jit(step, donate_argnums=(0, 1) if donate else (0,))
+    state2, _ = fn(state, batch)
+    return state2, batch.shape                 # batch only donated sometimes

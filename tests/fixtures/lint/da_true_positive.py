@@ -1,0 +1,13 @@
+"""Donation TRUE positive: a donated buffer is read after the dispatch."""
+import jax
+
+
+def make(step):
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def run(step, state, batch, table):
+    fn = jax.jit(step, donate_argnums=(0, 1))
+    new_state, metrics = fn(state, batch)      # donates state AND batch
+    extra = batch.sum()                        # DA501: batch was donated
+    return new_state, metrics, extra, table

@@ -1,0 +1,22 @@
+"""Generic-layer FALSE positives: exemptions that must hold."""
+import os  # noqa: F401 — kept for the doctest namespace
+from typing import TYPE_CHECKING
+
+try:
+    import fancy_json as json               # compat shim: never flagged
+except ImportError:
+    json = None
+
+if TYPE_CHECKING:
+    import pathlib                          # type-only: never flagged
+
+__all__ = ["exported_helper"]
+
+
+def exported_helper(x):
+    # string-keyed dicts with DISTINCT keys; f-string with a placeholder
+    return {"a": 1, "b": 2}, f"x={x}"
+
+
+def annotated(p: "pathlib.Path") -> str:
+    return str(p)

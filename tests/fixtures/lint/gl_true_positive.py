@@ -1,0 +1,13 @@
+"""Generic-layer TRUE positives."""
+import json                                     # GL901: never used
+import os
+
+HERE = os.sep
+
+TABLE = {
+    "a": 1,
+    "b": 2,
+    "a": 3,                                     # GL902: duplicate key
+}
+
+BANNER = f"no placeholders here"                # GL903

@@ -1,0 +1,30 @@
+"""Miniature consumer module for the project-level analyzer fixtures."""
+from .config import DataConfig
+
+
+def train(cfg):
+    b = cfg.data.batch_size          # valid read
+    r = cfg.fed.roundz               # CC201 true positive: typo'd key
+    return b, r
+
+
+def helper(data_cfg: DataConfig):
+    return data_cfg.documented       # annotation-alias read (no finding)
+
+
+def metrics(reg):
+    reg.counter("app.good_total", "catalogued and consistent")
+    reg.gauge("app.missing_gauge", "MC301: not in the catalogue")
+    reg.counter("bad name!", "MC302: not prometheus-sanitizable")
+    reg.gauge("app.good_total", "MC303: kind conflict with the counter")
+
+
+def guard(cfg):
+    if cfg.fed.rounds > 1 and cfg.data.batch_size > 128:
+        raise ValueError(
+            "fed.rounds>1 with data.batch_size>128 is not supported (fixture)"
+        )
+    if cfg.data.batch_size > 256:
+        raise ValueError(
+            "data.batch_size>256 requires fed.rounds=1 (fixture-unclaimed)"
+        )

@@ -1,0 +1,20 @@
+"""Miniature config schema for config-contract fixture tests."""
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataConfig:
+    batch_size: int = 64
+    dead_knob: int = 0        # CC202 true positive: never read anywhere
+    documented: bool = True
+
+
+@dataclass
+class FedConfig:
+    rounds: int = 3
+
+
+@dataclass
+class ExperimentConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    fed: FedConfig = field(default_factory=FedConfig)

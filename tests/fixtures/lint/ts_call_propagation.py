@@ -1,0 +1,25 @@
+"""Trace-safety call-graph propagation: the repo's real builder shape.
+
+``local_step`` is never passed to ``jax.jit`` itself — ``sharded_step``
+(which is) calls it, and also forwards it as a VALUE into a dispatch
+helper.  Both edges must make ``local_step`` a traced scope, or the
+hottest code in the tree goes unchecked.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _dispatch(fn, state, batch):
+    return fn(state, batch)
+
+
+def build():
+    def local_step(state, batch):
+        loss = jnp.mean(batch)
+        return state, float(loss)              # TS101: caught via propagation
+
+    def sharded_step(state, batch):
+        state, m = local_step(state, batch)    # direct call edge
+        return _dispatch(local_step, state, batch), m  # value-arg edge
+
+    return jax.jit(sharded_step)

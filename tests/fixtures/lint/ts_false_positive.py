@@ -1,0 +1,45 @@
+"""Trace-safety FALSE positives: nothing here may be flagged.
+
+Idioms the taint pass must understand: static shape reads, trace-time
+branching on statics, annotated-static params, host code outside traced
+scopes, and the documented suppression escape hatch.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_side(batch):
+    # not a traced scope: np/time/float on arrays is host business as usual
+    t0 = time.time()
+    return float(np.sum(batch)) + t0
+
+
+# fedrec-lint: traced-scope
+def marked_aggregate(x, method: str, trim_k: int):
+    # `method`/`trim_k` are annotated statics: trace-time dispatch is fine
+    if method == "mean":
+        return jnp.mean(x)
+    if trim_k > 0 and isinstance(x, jnp.ndarray):
+        return jnp.sort(x)[trim_k:-trim_k].mean()
+    return x
+
+
+def build(cfg, noise_fn):
+    def step(state, batch):
+        b = int(batch.shape[0])                # static: shape breaks taint
+        if cfg.use_extra:                      # static closure config
+            state = state + b
+        for _ in range(len(batch.shape)):      # len() is static
+            state = state + 1
+        if noise_fn is not None:               # identity test: static
+            state = noise_fn(state)
+        leaves = [jnp.square(x) for x in jax.tree_util.tree_leaves(state)]
+        if not leaves:                         # container emptiness: static
+            return state, 0.0
+        debug = batch.sum().item()             # fedrec-lint: disable=TS102 — fixture-documented probe
+        return state, debug
+
+    return jax.jit(step)

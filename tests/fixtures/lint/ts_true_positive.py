@@ -1,0 +1,22 @@
+"""Trace-safety TRUE positives: every construct here must be flagged."""
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build():
+    def step(state, batch):
+        loss = jnp.mean(batch)                 # tainted via jnp + param
+        bad_scalar = float(loss)               # TS101
+        host = loss.item()                     # TS102
+        arr = np.sum(batch)                    # TS103
+        t0 = time.time()                       # TS104
+        r = random.random()                    # TS104
+        if loss > 0:                           # TS105
+            state = state + 1
+        return state, (bad_scalar, host, arr, t0, r)
+
+    return jax.jit(step)
