@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke comm-cost pallas-bench
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke comm-cost pallas-bench table-capacity
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -26,6 +26,19 @@ obs-smoke:
 # recovery leg quarantines + rolls back instead of aborting
 chaos-smoke:
 	@bash scripts/chaos_smoke.sh
+
+# sharding smoke: a REAL 2-process gloo CPU world (2x4 fake devices, one
+# global 8-device mesh) running the sharded-catalog train step — asserts
+# survival, rows/device = padded/8, bit-identity with the replicated
+# table, and fsdp at-rest sharding with cross-process-identical losses
+shard-smoke:
+	@bash scripts/shard_smoke.sh
+
+# catalog-capacity benchmark: rows-per-device x devices frontier
+# (replicated vs sharded) + a measured sharded-gather exactness/latency
+# leg on the local backend; banks benchmarks/table_capacity.json
+table-capacity:
+	@python benchmarks/table_capacity.py
 
 # communication-cost benchmark: measured per-codec wire buffers of the
 # flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);

@@ -672,6 +672,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] cpu fused leg failed: {e}\n")
 
+        # sharded-gather leg needs a real multi-device mesh; the CPU
+        # fallback runs one device, so the measured CPU form lives in
+        # benchmarks/table_capacity.py (8-device fake mesh, exactness +
+        # residency + exchange timing) — point there instead of silence
+        if len(jax.devices()) < 2:
+            out["sharded_gather_note"] = (
+                "single-device backend: the owner-bucketed sharded-table "
+                "gather leg needs >=2 devices — run `make table-capacity` "
+                "(8-device fake CPU mesh) or `make shard-smoke` (2-process "
+                "gloo world) for the CPU-honest measurements"
+            )
+
     cache_path = Path(__file__).parent / "benchmarks" / "last_tpu_bench.json"
     if not on_tpu and cache_path.exists():
         # The tunnel to the chip wedges transiently (sometimes for hours).
@@ -1052,6 +1064,62 @@ def main() -> None:
             stamp_and_cache()
         except Exception as e:  # noqa: BLE001
             sys.stderr.write(f"[bench] decoupled bonus metric failed: {e}\n")
+
+        # sharded catalog (shard.table, ISSUE 11): the same joint step with
+        # the token-state table row-sharded over a multi-device client mesh
+        # and gathered via the owner-bucketed all_to_all exchange, against
+        # the config-matched replicated-table step on the SAME mesh — what
+        # one step pays for linear catalog capacity. Needs >= 2 devices.
+        # A bonus metric: its failure must not discard the primary numbers.
+        try:
+            n_dev = len(jax.devices())
+            if n_dev >= 2:
+                from fedrec_tpu.shard.table import ShardedNewsTable
+
+                n_sh = min(4, n_dev)
+                cfg_sh = copy.deepcopy(cfg)
+                cfg_sh.fed.num_clients = n_sh
+                mesh_sh = client_mesh(n_sh)
+                tab = ShardedNewsTable.create(
+                    np.asarray(token_states), mesh_sh, cfg_sh.fed.mesh_axis
+                )
+                step_rep = build_fed_train_step(
+                    model, cfg_sh, get_strategy("grad_avg"), mesh_sh,
+                    mode="joint",
+                )
+                step_sh = build_fed_train_step(
+                    model, cfg_sh, get_strategy("grad_avg"), mesh_sh,
+                    mode="joint", sharded_table=tab.spec,
+                )
+
+                def make_mesh_batch(seed: int, bsz: int, n_clients: int = 1):
+                    return make_batch(seed, bsz, n_clients=n_sh)
+
+                dt_rep = measure(
+                    B, iters=10, the_step=step_rep, n_clients=n_sh,
+                    the_cfg=cfg_sh, batch_maker=make_mesh_batch,
+                )
+                dt_sh = measure(
+                    B, iters=10,
+                    the_step=lambda st, b, t: step_sh(st, b, tab.rows),
+                    n_clients=n_sh, the_cfg=cfg_sh,
+                    batch_maker=make_mesh_batch,
+                )
+                out["sharded_gather"] = {
+                    "devices": n_sh,
+                    "rows_per_device": tab.spec.rows_per_shard,
+                    "replicated_samples_per_sec": round(n_sh * B / dt_rep, 2),
+                    "sharded_samples_per_sec": round(n_sh * B / dt_sh, 2),
+                    "sharded_vs_replicated": round(dt_rep / dt_sh, 3),
+                    "note": (
+                        "capacity lever, not a speed lever: the sharded "
+                        "row buys rows/device = N/devices at this step-"
+                        "time ratio (docs/OPERATIONS.md §3e)"
+                    ),
+                }
+                stamp_and_cache()
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] sharded-gather bonus metric failed: {e}\n")
 
     if not on_tpu:
         # no cached chip artifact existed, so this CPU run IS the primary

@@ -69,7 +69,7 @@ CANDIDATE_MARKERS = (
 # also named in the message
 CONDITIONAL_MARKERS = ("requires", "needs", "assumes")
 FLAG_TOKEN_RE = re.compile(
-    r"\b(data|model|optim|fed|privacy|train|obs|chaos)\.[a-z_]"
+    r"\b(data|model|optim|fed|privacy|shard|train|obs|chaos)\.[a-z_]"
 )
 
 

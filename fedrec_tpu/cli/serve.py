@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-probe", type=int, default=8)
     p.add_argument("--exact-threshold", type=int, default=4096,
                    help="catalogs at/below this size always use exact scoring")
+    p.add_argument("--shard-store", action="store_true",
+                   help="row-shard the embedding store across this "
+                        "process's devices (fedrec_tpu.shard): per-device "
+                        "HBM holds catalog/devices rows, the exact scorer "
+                        "reads the sharded table transparently. Exact "
+                        "retrieval only (incompatible with --clusters)")
     # ---- model / data sources
     p.add_argument("--synthetic", type=int, default=0, metavar="N",
                    help="serve a random N-item catalog with fresh-init params "
@@ -85,7 +91,12 @@ def _synthetic_service(args, cfg):
         jax.random.PRNGKey(0), dummy, method=NewsRecommender.encode_user
     )["params"]["user_encoder"]
     store = EmbeddingStore()
-    store.publish(table, user_params, source="synthetic")
+    if args.shard_store:
+        from fedrec_tpu.serving.store import publish_sharded
+
+        publish_sharded(store, table, user_params, source="synthetic")
+    else:
+        store.publish(table, user_params, source="synthetic")
     return _service(args, cfg, model, store, id_map=None)
 
 
@@ -110,7 +121,7 @@ def _checkpoint_service(args, cfg):
     store = EmbeddingStore()
     gen = publish_from_checkpoint(
         store, model, snap_dir, token_states, valid_mask=valid,
-        dtype=cfg.model.dtype,
+        dtype=cfg.model.dtype, shard=args.shard_store,
     )
     print(f"[serve] generation 0 from {gen.source} round {gen.round}",
           file=sys.stderr)
@@ -146,6 +157,14 @@ def main(argv: list[str] | None = None) -> int:
     cfg = ExperimentConfig()
     cfg.apply_overrides(args.overrides)
 
+    if args.shard_store and args.clusters:
+        print(
+            "[serve] ERROR: --shard-store pairs with exact retrieval only "
+            "(the k-means member lists are host-built per cluster); drop "
+            "--clusters or --shard-store",
+            file=sys.stderr,
+        )
+        return 2
     service = (
         _synthetic_service(args, cfg) if args.synthetic
         else _checkpoint_service(args, cfg)

@@ -255,6 +255,46 @@ class PopulationConfig:
 
 
 @dataclass
+class ShardConfig:
+    """Model/catalog sharding (``fedrec_tpu.shard``) — scale state past
+    per-device HBM.
+
+    ``fsdp`` adds an ``fsdp`` mesh axis (``parallel.mesh.fed_mesh``) and
+    keeps every client's AT-REST state — parameters, optimizer moments,
+    grad accumulators, codec residuals — sharded across it per the
+    size-aware largest-evenly-divisible-dimension policy
+    (``shard.policy``, SNIPPETS [2]): scalars/1-D and sub-threshold
+    leaves replicated, 2-D+ leaves sharded along the largest dim the
+    axis size divides evenly, replicate fallback.  The compiled step
+    gathers on entry and re-shards on exit (ZeRO-style residency), so
+    the trajectory is bit-identical to the replicated layout
+    (``tests/test_shard_fsdp.py``); ``fsdp=1`` builds the exact pre-PR
+    1-D mesh and programs.  Not combinable with ``fed.seq_shards>1``
+    (both claim the second mesh axis).
+
+    ``table`` row-shards the frozen token-state news table across the
+    client mesh axis behind ``shard.table.ShardedNewsTable``: each step
+    buckets its unique news ids by owner shard, ``all_to_all``s the id
+    buckets out and the gathered rows back (fixed shapes, exact —
+    ``docs/DESIGN.md`` §5i), so catalog capacity scales linearly with
+    devices instead of per-device HBM.  Composes with
+    ``data.gather_chunk`` / the unique-cap policy; joint ("head") mode
+    only, and not with ``model.fuse_hot_path``, DP-SGD, seq sharding or
+    in-device cohorts (the step builders fail fast).
+    """
+
+    # fsdp axis size: shard at-rest client state across this many devices
+    # per client slot. 1 = off (bit-identical degenerate layout).
+    fsdp: int = 1
+    # leaves smaller than this many MB (per client) stay replicated —
+    # sharding tiny tensors buys nothing and costs collective latency
+    fsdp_min_size_mb: float = 4.0
+    # row-shard the token-state news table over the client mesh axis with
+    # the in-step owner-bucketed all_to_all gather
+    table: bool = False
+
+
+@dataclass
 class FedConfig:
     """Federation strategy (reference modes a-d, SURVEY.md section 0)."""
 
@@ -514,6 +554,7 @@ class ExperimentConfig:
     optim: OptimConfig = field(default_factory=OptimConfig)
     fed: FedConfig = field(default_factory=FedConfig)
     privacy: PrivacyConfig = field(default_factory=PrivacyConfig)
+    shard: ShardConfig = field(default_factory=ShardConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)

@@ -360,6 +360,29 @@ def build_report(
                 comm["deadline_misses"] = misses
             report["communication"] = comm
 
+        # ---- sharding: the layout summary — fsdp shards + at-rest state
+        # bytes per device, sharded-catalog occupancy/residency, and the
+        # modeled owner-bucketed all_to_all traffic. Keyed on the layout
+        # actually being sharded (fsdp > 1 or a per-step a2a wire model),
+        # so a replicated run stays silent.
+        fsdp = snapshot_value(last, "shard.fsdp_shards")
+        a2a = snapshot_value(last, "shard.a2a_bytes_total")
+        if (fsdp and fsdp > 1) or a2a:
+            sh: dict[str, Any] = {}
+            if fsdp:
+                sh["fsdp_shards"] = fsdp
+            for key, name in (
+                ("state_bytes_per_device", "shard.state_bytes_per_device"),
+                ("table_rows_per_device", "shard.table_rows_per_device"),
+                ("table_occupancy", "shard.table_occupancy"),
+                ("remote_gather_rows", "shard.remote_gather_rows"),
+                ("a2a_bytes", "shard.a2a_bytes_total"),
+            ):
+                v = snapshot_value(last, name)
+                if v is not None:
+                    sh[key] = v
+            report["sharding"] = sh
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -526,6 +549,41 @@ def render_text(report: dict) -> str:
             )
         if "deadline_misses" in comm:
             lines.append(f"dcn deadline misses: {int(comm['deadline_misses'])}")
+        lines.append("")
+    shd = report.get("sharding")
+    if shd:
+        lines.append("## Sharding")
+
+        def _mib(n: float) -> str:
+            return f"{n / (1024 * 1024):.2f} MB"
+
+        layout = []
+        if shd.get("fsdp_shards"):
+            layout.append(f"fsdp shards: {int(shd['fsdp_shards'])}")
+        if "state_bytes_per_device" in shd:
+            layout.append(
+                f"state/device: {_mib(shd['state_bytes_per_device'])}"
+            )
+        if layout:
+            lines.append(", ".join(layout))
+        if "table_rows_per_device" in shd:
+            occ = (
+                f", occupancy: {shd['table_occupancy']:.1%}"
+                if "table_occupancy" in shd else ""
+            )
+            lines.append(
+                f"catalog rows/device: {int(shd['table_rows_per_device'])}"
+                + occ
+            )
+        if "a2a_bytes" in shd:
+            remote = (
+                f" (worst-case {int(shd['remote_gather_rows'])} remote "
+                "rows/step)"
+                if "remote_gather_rows" in shd else ""
+            )
+            lines.append(
+                f"gather all_to_all: {_mib(shd['a2a_bytes'])}{remote}"
+            )
         lines.append("")
     if "cap_overflow_steps" in report:
         lines.append(f"cap-overflow steps: {int(report['cap_overflow_steps'])}")

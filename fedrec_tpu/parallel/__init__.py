@@ -1,4 +1,5 @@
 from fedrec_tpu.parallel.mesh import (
+    FSDP_AXIS,
     client_mesh,
     client_sharding,
     fed_mesh,
@@ -13,6 +14,7 @@ from fedrec_tpu.parallel.ring import (
 )
 
 __all__ = [
+    "FSDP_AXIS",
     "client_mesh",
     "client_sharding",
     "fed_mesh",

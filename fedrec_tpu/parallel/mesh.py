@@ -28,6 +28,9 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "clients"
+# the parameter-sharding axis (shard.fsdp > 1): client state at rest is
+# sharded across it per fedrec_tpu.shard.policy; compute gathers on entry
+FSDP_AXIS = "fsdp"
 
 
 def client_mesh(
@@ -78,12 +81,27 @@ def client_mesh(
 
 
 def fed_mesh(cfg: Any, local: bool = True) -> Mesh:
-    """Mesh for an ExperimentConfig: 1-D ``(clients,)``, or 2-D
+    """Mesh for an ExperimentConfig: 1-D ``(clients,)``, 2-D
     ``(clients, seq)`` when ``fed.seq_shards > 1`` (long-history sequence
     parallelism — each client's history attention spans ``seq_shards`` chips
-    via ring/Ulysses collectives, see ``fedrec_tpu.parallel.ring``).
+    via ring/Ulysses collectives, see ``fedrec_tpu.parallel.ring``), or 2-D
+    ``(clients, fsdp)`` when ``shard.fsdp > 1`` (at-rest parameter/optimizer
+    sharding per ``fedrec_tpu.shard.policy``; ``fsdp=1`` builds the exact
+    1-D mesh, so the degenerate config is bit-identical to pure data
+    parallelism by construction).
     """
     n_cli, n_seq = cfg.fed.num_clients, cfg.fed.seq_shards
+    n_fsdp = getattr(getattr(cfg, "shard", None), "fsdp", 1)
+    if n_fsdp > 1:
+        if n_seq > 1:
+            raise ValueError(
+                f"shard.fsdp={n_fsdp} with fed.seq_shards={n_seq} is not "
+                "supported: both claim the mesh's second axis — unset one "
+                "of the two"
+            )
+        return _two_axis_mesh(
+            cfg, n_cli, n_fsdp, FSDP_AXIS, "shard.fsdp", local
+        )
     if n_seq <= 1:
         return client_mesh(n_cli, cfg.fed.mesh_axis, local=local)
     if cfg.data.max_his_len % n_seq != 0:
@@ -91,28 +109,44 @@ def fed_mesh(cfg: Any, local: bool = True) -> Mesh:
             f"data.max_his_len={cfg.data.max_his_len} must be divisible by "
             f"fed.seq_shards={n_seq} to shard the history axis"
         )
+    return _two_axis_mesh(
+        cfg, n_cli, n_seq, cfg.fed.seq_axis, "fed.seq_shards", local
+    )
+
+
+def _two_axis_mesh(
+    cfg: Any,
+    n_cli: int,
+    n_second: int,
+    second_axis: str,
+    flag: str,
+    local: bool,
+) -> Mesh:
+    """A 2-D ``(clients, <second>)`` mesh with the same cohort policy as
+    :func:`client_mesh` on the clients axis — shared by the seq-parallel
+    and fsdp layouts so slot/cohort arithmetic cannot diverge."""
     devices = jax.local_devices() if local else jax.devices()
-    cli_slots = len(devices) // n_seq
+    cli_slots = len(devices) // n_second
     if cli_slots < 1:
         raise ValueError(
-            f"fed.seq_shards={n_seq} exceeds {len(devices)} devices; "
+            f"{flag}={n_second} exceeds {len(devices)} devices; "
             "set XLA_FLAGS=--xla_force_host_platform_device_count for simulation"
         )
     if n_cli <= cli_slots:
         size = n_cli
     elif n_cli % cli_slots == 0:
-        size = cli_slots  # cohorts: size*n_seq devices, n_cli/size per slot
+        size = cli_slots  # cohorts: size*n_second devices, n_cli/size per slot
     else:
         raise ValueError(
             f"num_clients={n_cli} exceeds the {cli_slots} client slots of a "
-            f"{len(devices)}-device mesh with seq_shards={n_seq} and is not "
+            f"{len(devices)}-device mesh with {flag}={n_second} and is not "
             "divisible by the slot count (cohort sharding needs equal "
             "cohorts); set XLA_FLAGS=--xla_force_host_platform_device_count"
         )
     mesh_devices = mesh_utils.create_device_mesh(
-        (size, n_seq), devices=devices[: size * n_seq]
+        (size, n_second), devices=devices[: size * n_second]
     )
-    return Mesh(mesh_devices, (cfg.fed.mesh_axis, cfg.fed.seq_axis))
+    return Mesh(mesh_devices, (cfg.fed.mesh_axis, second_axis))
 
 
 def fed_batch_spec(key: str, cfg: Any, mesh: Mesh) -> P:

@@ -137,6 +137,74 @@ class EmbeddingStore:
             return gen
 
 
+def shard_news_vecs(
+    news_vecs, devices: list | None = None
+) -> tuple[Any, int]:
+    """Row-shard an ``(N, D)`` news-vector table across this process's
+    devices — the serving half of the sharded catalog (``shard.table``):
+    per-device HBM holds ``ceil(N / n_devices)`` rows instead of N, so a
+    million-item catalog serves from a slice without the k-means index
+    being the only option.
+
+    Returns ``(sharded_table, real_rows)``: the table zero-padded to a
+    device-count multiple and committed to a 1-D ``rows`` mesh
+    (``NamedSharding``), plus the real row count. Pad rows must never be
+    served — :func:`publish_sharded` masks them via ``valid_mask``, which
+    both the exact scorer and the index build respect. The jitted exact
+    scorer consumes the sharded table transparently (XLA inserts the
+    collectives where a consumer needs replication).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    from fedrec_tpu.shard.table import ShardedNewsTable
+
+    devices = list(devices) if devices is not None else jax.local_devices()
+    mesh = Mesh(np.asarray(devices), ("rows",))
+    # ONE pad-and-commit rule for train- and serve-side tables: delegate
+    # to the sharding subsystem's constructor so the two can never diverge
+    tab = ShardedNewsTable.create(news_vecs, mesh, "rows")
+    return tab.rows, tab.spec.num_rows
+
+
+def publish_sharded(
+    store: EmbeddingStore,
+    news_vecs,
+    user_params,
+    valid_mask: np.ndarray | None = None,
+    round: int | None = None,
+    source: str = "manual",
+    devices: list | None = None,
+    registry=None,
+) -> Generation:
+    """:meth:`EmbeddingStore.publish` with the table row-sharded across
+    local devices (:func:`shard_news_vecs`). Pad rows get ``valid_mask``
+    False so retrieval can never emit them; the
+    ``shard.table_rows_per_device`` gauge records the per-device
+    residency. Atomicity is inherited — the sharded table is built fully
+    before the one publish point swaps it in."""
+    sharded, n = shard_news_vecs(news_vecs, devices=devices)
+    padded = int(sharded.shape[0])
+    mask = np.zeros(padded, bool)
+    mask[:n] = True if valid_mask is None else np.asarray(valid_mask, bool)[:n]
+    reg = registry or get_registry()
+    n_dev = max(
+        len(devices) if devices is not None else len(sharded.devices()), 1
+    )
+    reg.gauge(
+        "shard.table_rows_per_device",
+        "news-catalog rows resident per device (= catalog rows under "
+        "the replicated layout; padded_rows / shards under shard.table)",
+    ).set(padded / n_dev)
+    return store.publish(
+        sharded,
+        user_params,
+        valid_mask=mask,
+        round=round,
+        source=f"{source}:sharded",
+    )
+
+
 def load_checkpoint_params(
     snap_dir: str | Path, log=None
 ) -> tuple[Any, Any, int | None, str]:
@@ -213,12 +281,15 @@ def publish_from_checkpoint(
     token_states: np.ndarray,
     valid_mask: np.ndarray | None = None,
     dtype: str = "float32",
+    shard: bool = False,
 ) -> Generation:
     """Refresh flow: checkpoint -> ``encode_all_news`` -> atomic publish.
 
     ``token_states`` is the (N, L, bert_hidden) cached-trunk table the
     table/head modes serve from (the finetune path would re-encode tokens;
     the server keeps that out of the hot path by requiring states here).
+    ``shard`` routes through :func:`publish_sharded` — the table lands
+    row-sharded across local devices instead of replicated.
     """
     import jax.numpy as jnp
 
@@ -228,6 +299,11 @@ def publish_from_checkpoint(
     table = encode_all_news(
         model, news_params, jnp.asarray(token_states, jnp.dtype(dtype))
     )
+    if shard:
+        return publish_sharded(
+            store, table, user_params, valid_mask=valid_mask,
+            round=round_, source=f"checkpoint:{kind}",
+        )
     return store.publish(
         table,
         user_params,

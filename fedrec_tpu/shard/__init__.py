@@ -1,0 +1,45 @@
+"""Sharding subsystem: scale the news catalog and the client state past
+per-device HBM (ROADMAP item 2).
+
+Two pillars, one package:
+
+* :mod:`fedrec_tpu.shard.policy` — size-aware FSDP parameter sharding
+  (``shard.fsdp``): the SNIPPETS [2] largest-evenly-divisible-dimension
+  pytree -> ``NamedSharding`` rule, applied to params AND optimizer
+  moments via ``jax.eval_shape``; ``fsdp=1`` degenerates bit-identically
+  to the replicated layout.
+* :mod:`fedrec_tpu.shard.table` — the mesh-sharded news catalog
+  (``shard.table``): ``token_states`` row-sharded behind
+  :class:`~fedrec_tpu.shard.table.ShardedNewsTable`, gathered in-step by
+  the fixed-shape owner-bucketed ``all_to_all`` exchange; catalog
+  capacity scales linearly with devices.
+
+docs/DESIGN.md §5i (design), docs/OPERATIONS.md "sizing a catalog across
+a slice" (runbook), ``make shard-smoke`` (2-process gloo CPU world).
+"""
+
+from fedrec_tpu.shard.policy import (
+    FSDP_AXIS,
+    fsdp_leaf_sharding,
+    fsdp_shardings,
+    fsdp_state_shardings,
+    shard_bytes_per_device,
+)
+from fedrec_tpu.shard.table import (
+    ShardedNewsTable,
+    TableSpec,
+    a2a_bytes_per_gather,
+    owner_bucketed_gather,
+)
+
+__all__ = [
+    "FSDP_AXIS",
+    "ShardedNewsTable",
+    "TableSpec",
+    "a2a_bytes_per_gather",
+    "fsdp_leaf_sharding",
+    "fsdp_shardings",
+    "fsdp_state_shardings",
+    "owner_bucketed_gather",
+    "shard_bytes_per_device",
+]

@@ -55,6 +55,32 @@ def verify_state_tree(state: Any, samples_per_leaf: int = 256) -> None:
                 )
 
 
+def gather_for_save(state: Any) -> Any:
+    """Make every leaf of a state pytree checkpoint-safe regardless of its
+    device layout — the gather-on-save half of the sharded-checkpoint
+    contract (``shard.fsdp`` / ``shard.table``; the restore half is the
+    template-driven ``restore`` + the Trainer's ``_place_state``
+    re-commit).
+
+    Fully-addressable leaves (host arrays, replicated device arrays, and
+    single-host sharded arrays) pass through untouched — orbax serializes
+    them as-is, so the no-shard path is byte-identical to the pre-shard
+    snapshot format. A NON-fully-addressable leaf (a multi-host mesh
+    holding only its slice of the fsdp axis) is gathered to a host copy
+    via ``process_allgather`` first; without this, orbax's save would
+    require every process to hold every shard and fail.
+    """
+
+    def one(x: Any) -> Any:
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x)
+        return x
+
+    return jax.tree_util.tree_map(one, state)
+
+
 class SnapshotManager:
     def __init__(self, directory: str | Path, max_to_keep: int = 3):
         self.directory = Path(directory).absolute()
@@ -85,8 +111,15 @@ class SnapshotManager:
         stalling the step stream. Readers (``latest_round``/``restore``) and
         ``close`` settle in-flight saves first, so no torn snapshot is ever
         observable. ``wait=True`` restores the blocking behavior.
+
+        Sharded leaves round-trip: non-fully-addressable arrays gather to
+        host first (:func:`gather_for_save`), and ``restore`` hands back
+        whatever layout the caller's template asks for — a ``shard.fsdp``
+        run resumes bit-identically (``tests/test_shard_fsdp.py``).
         """
-        self.manager.save(round_idx, args=ocp.args.StandardSave(state))
+        self.manager.save(
+            round_idx, args=ocp.args.StandardSave(gather_for_save(state))
+        )
         if wait:
             self.manager.wait_until_finished()
 

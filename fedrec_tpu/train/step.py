@@ -230,6 +230,7 @@ def _encode_gathered(
     uniq: jnp.ndarray,
     chunk: int = 0,
     fused: bool = False,
+    gather_fn: Callable | None = None,
 ) -> jnp.ndarray:
     """Gather unique token-state rows and run the text head over them.
 
@@ -253,8 +254,20 @@ def _encode_gathered(
     ``stop_gradient`` on the table keeps the frozen-trunk contract and the
     kernel's VJP never computes a table cotangent anyway. Composes with
     ``chunk`` unchanged (the tile body swaps implementations).
+
+    ``gather_fn(table, ids) -> rows`` swaps the local ``table[ids]`` for
+    the sharded-catalog exchange (``shard.table``,
+    ``shard.table.owner_bucketed_gather``): collectives live inside the
+    per-tile body, so ``chunk`` tiling replays the exchange per tile in
+    lockstep on every device (same static trip count everywhere), and the
+    ``stop_gradient`` outside it keeps any cotangent from ever touching
+    the wire.
     """
     from jax.ad_checkpoint import checkpoint_name
+
+    if gather_fn is None:
+        def gather_fn(t, ids):
+            return t[ids]
 
     if fused:
         from fedrec_tpu.ops import fused_gather_encode
@@ -271,7 +284,7 @@ def _encode_gathered(
     else:
         def encode(ids):
             states = checkpoint_name(
-                lax.stop_gradient(token_states[ids]), "token_gather"
+                lax.stop_gradient(gather_fn(token_states, ids)), "token_gather"
             )
             return model.apply(
                 {"params": {"text_head": news_params}},
@@ -297,6 +310,8 @@ def _batch_news_vecs(
     cap: int = 0,
     chunk: int = 0,
     fused: bool = False,
+    gather_fn: Callable | None = None,
+    n_news: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Encode the batch's unique news once; gather into cand/history slots.
 
@@ -308,12 +323,16 @@ def _batch_news_vecs(
     encoded — the worst case B*(C+H) wastes text-tower FLOPs on
     duplicate/padding rows. Exact while distinct ids <= cap; callers must
     surface :func:`unique_overflow` when setting it. ``chunk``: see
-    :func:`_encode_gathered`.
+    :func:`_encode_gathered`. ``gather_fn``/``n_news``: the sharded-
+    catalog form (``shard.table``) — ``token_states`` is then this
+    device's local row block, so the GLOBAL row count must come in
+    explicitly (the local block's dim 0 would wrongly cap the dedup).
     """
     b, c = candidates.shape
     h = history.shape[1]
     ids = jnp.concatenate([candidates.reshape(-1), history.reshape(-1)])
-    n_news = token_states.shape[0]
+    if n_news is None:
+        n_news = token_states.shape[0]
     size = min(ids.shape[0], n_news)
     if cap:
         size = min(size, cap)
@@ -321,7 +340,8 @@ def _batch_news_vecs(
         ids, size=size, fill_value=0, return_inverse=True
     )
     vecs = _encode_gathered(
-        model, news_params, token_states, uniq, chunk, fused=fused
+        model, news_params, token_states, uniq, chunk, fused=fused,
+        gather_fn=gather_fn,
     )
     flat = vecs[inv]
     cand_vecs = flat[: b * c].reshape(b, c, -1)
@@ -515,6 +535,29 @@ def encode_all_news_sharded(
     return enc(news_params, padded)[:n]
 
 
+def _reshard_state_out(fn: Callable, state_shardings: Any) -> Callable:
+    """Wrap a compiled program so its STATE output is re-committed to the
+    at-rest FSDP layout (``shard.policy``) inside the same program: the
+    ``shard_map`` in-spec forces the gather on entry, this constraint is
+    the slice on exit — one dispatch, no host round-trip, and donation
+    still works because input and output carry identical layouts.
+    ``None`` returns ``fn`` untouched (the byte-identical ``fsdp=1``
+    degenerate program)."""
+    if state_shardings is None:
+        return fn
+
+    def wrapped(*args):
+        out = fn(*args)
+        if isinstance(out, tuple):
+            return (
+                jax.lax.with_sharding_constraint(out[0], state_shardings),
+                *out[1:],
+            )
+        return jax.lax.with_sharding_constraint(out, state_shardings)
+
+    return wrapped
+
+
 # ------------------------------------------------------------- train steps
 def _build_local_step(
     model: NewsRecommender,
@@ -523,6 +566,7 @@ def _build_local_step(
     mesh: Mesh,
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
+    sharded_table: Any | None = None,
 ) -> tuple[Callable, int, Any, str]:
     """The ONE construction of the per-client step math.
 
@@ -536,6 +580,13 @@ def _build_local_step(
     reference ``client.py:87-89``). When None and ``cfg.privacy.enabled``, it
     is built from the config; with ``mechanism='dpsgd'`` the joint path
     additionally switches to per-example clipped gradients.
+
+    ``sharded_table`` (a ``shard.table.TableSpec``, from ``shard.table``):
+    the feature table arrives as this device's LOCAL row block instead of
+    the replicated array, and the unique-news gather runs the
+    owner-bucketed ``all_to_all`` exchange — bit-identical rows, catalog
+    capacity scaling with the mesh. Joint ("head") mode only; the
+    unsupported combinations fail fast here, at build time.
     """
     if mode is None:
         mode = {"table": "decoupled", "head": "joint", "finetune": "finetune"}.get(
@@ -632,6 +683,51 @@ def _build_local_step(
                 "(the fused kernel holds the whole history per row); use "
                 "the ring/Ulysses path for sharded histories"
             )
+
+    # mesh-sharded news catalog (shard.table, fedrec_tpu.shard.table): the
+    # table in-spec becomes P(clients) and every unique-news gather runs
+    # the owner-bucketed all_to_all exchange. The combinations the
+    # exchange cannot serve fail fast HERE, with the lever to unset.
+    table_gather = None
+    if sharded_table is not None:
+        if mode != "joint":
+            raise NotImplementedError(
+                "shard.table requires model.text_encoder_mode='head' (the "
+                "joint frozen-trunk step): the decoupled per-epoch table "
+                "refresh and the finetune token gather read a replicated "
+                "table — unset shard.table for those modes"
+            )
+        if use_dpsgd:
+            raise NotImplementedError(
+                "shard.table with privacy.mechanism='dpsgd' is not "
+                "supported (per-example clipping gathers each example's "
+                "rows directly, bypassing the owner-bucketed exchange); "
+                "unset one of the two"
+            )
+        if n_seq > 1:
+            raise NotImplementedError(
+                "shard.table with fed.seq_shards>1 is not supported (the "
+                "catalog shards over the clients axis; a seq-sharded mesh "
+                "would need a 2-D exchange); unset one of the two"
+            )
+        if fuse:
+            raise NotImplementedError(
+                "model.fuse_hot_path with shard.table is not supported "
+                "until the fused gather+encode kernel learns remote rows "
+                "(it streams LOCAL HBM rows only); unset one of the two"
+            )
+        if k > 1:
+            raise NotImplementedError(
+                "shard.table with in-device cohorts (fed.num_clients above "
+                "the mesh's client slots) is not supported: the "
+                "owner-bucketed all_to_all runs once per mesh slot, not "
+                "per vmapped cohort client — match fed.num_clients to the "
+                "device count"
+            )
+        from fedrec_tpu.shard.table import owner_bucketed_gather
+
+        def table_gather(rows, ids):
+            return owner_bucketed_gather(rows, ids, sharded_table)
 
     # in-graph numeric sentry (obs.health.sentry): the step additionally
     # returns per-client grad/update/param global norms and a non-finite
@@ -754,6 +850,11 @@ def _build_local_step(
                             cap=cap,
                             chunk=cfg.data.gather_chunk,
                             fused=fuse_gather,
+                            gather_fn=table_gather,
+                            n_news=(
+                                sharded_table.num_rows
+                                if sharded_table is not None else None
+                            ),
                         )
                     if n_seq > 1:
                         # candidate encoding is replicated across seq shards;
@@ -917,7 +1018,11 @@ def _build_local_step(
             # bypassing the capped joint dedup — so no flag there.)
             flag = unique_overflow(
                 batch["candidates"], batch["history"],
-                cap, table.shape[0],
+                cap,
+                # sharded table: the LOCAL block's dim 0 is rows/shard, not
+                # the catalog — the dedup bound must use the global count
+                sharded_table.num_rows if sharded_table is not None
+                else table.shape[0],
             )
             if n_seq > 1:
                 # each seq shard dedups its own history slice, so overflow
@@ -950,6 +1055,8 @@ def build_fed_train_step(
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
     donate_batch: bool = False,
+    sharded_table: Any | None = None,
+    state_shardings: Any | None = None,
 ) -> Callable:
     """Compile the per-batch federated train step.
 
@@ -964,15 +1071,23 @@ def build_fed_train_step(
     device_puts fresh arrays every dispatch, so XLA may reclaim them as
     scratch once consumed); leave False when re-dispatching the same batch
     arrays (bench.py's chain timer does).
+
+    ``sharded_table`` (a ``shard.table.TableSpec``): the feature table is
+    row-sharded over the clients axis instead of replicated, gathered
+    in-step by the owner-bucketed exchange. ``state_shardings`` (from
+    ``shard.policy.fsdp_state_shardings``): the returned state re-commits
+    to the at-rest FSDP layout inside the same program. Both default to
+    None = the byte-identical pre-shard program.
     """
     local_step, k, batch_spec, axis = _build_local_step(
-        model, cfg, strategy, mesh, mode, noise_fn
+        model, cfg, strategy, mesh, mode, noise_fn, sharded_table
     )
+    table_spec = P(axis) if sharded_table is not None else P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), batch_spec, P()),
+        in_specs=(P(axis), batch_spec, table_spec),
         out_specs=(P(axis), P(axis)),
         check_vma=False,
     )
@@ -980,7 +1095,8 @@ def build_fed_train_step(
         return _cohort_call(local_step, k, 2, stacked_state, batch, table)
 
     return jax.jit(
-        sharded_step, donate_argnums=(0, 1) if donate_batch else (0,)
+        _reshard_state_out(sharded_step, state_shardings),
+        donate_argnums=(0, 1) if donate_batch else (0,),
     )
 
 
@@ -1000,6 +1116,8 @@ def build_fed_train_scan(
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
     donate_batch: bool = False,
+    sharded_table: Any | None = None,
+    state_shardings: Any | None = None,
 ) -> Callable:
     """Epoch-in-jit: ``lax.scan`` the train step over a STACK of batches.
 
@@ -1016,13 +1134,14 @@ def build_fed_train_scan(
     step math lands in both.
     """
     local_step, k, batch_spec, axis = _build_local_step(
-        model, cfg, strategy, mesh, mode, noise_fn
+        model, cfg, strategy, mesh, mode, noise_fn, sharded_table
     )
+    table_spec = P(axis) if sharded_table is not None else P()
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), _prepend_none(batch_spec), P()),
+        in_specs=(P(axis), _prepend_none(batch_spec), table_spec),
         out_specs=(P(axis), _prepend_none(P(axis))),
         check_vma=False,
     )
@@ -1034,7 +1153,8 @@ def build_fed_train_scan(
         return lax.scan(one, stacked_state, batches)
 
     return jax.jit(
-        sharded_scan, donate_argnums=(0, 1) if donate_batch else (0,)
+        _reshard_state_out(sharded_scan, state_shardings),
+        donate_argnums=(0, 1) if donate_batch else (0,),
     )
 
 
@@ -1082,6 +1202,8 @@ def build_fed_round_scan(
     mode: str | None = None,
     noise_fn: Callable[[Any, jax.Array], Any] | None = None,
     donate_batch: bool = False,
+    sharded_table: Any | None = None,
+    state_shardings: Any | None = None,
 ) -> Callable:
     """Rounds-in-jit: whole federated ROUNDS in one XLA dispatch.
 
@@ -1105,8 +1227,9 @@ def build_fed_round_scan(
     turning this into a plain multi-epoch-in-jit.
     """
     local_step, k, batch_spec, axis = _build_local_step(
-        model, cfg, strategy, mesh, mode, noise_fn
+        model, cfg, strategy, mesh, mode, noise_fn, sharded_table
     )
+    table_spec = P(axis) if sharded_table is not None else P()
     _, sync_axes = cohort_axes(cfg, mesh)
     local_round_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust, cfg.fed)
     codec_sync = compressed_sync_active(cfg, strategy)
@@ -1117,7 +1240,7 @@ def build_fed_round_scan(
         in_specs=(
             P(axis),
             _prepend_none(_prepend_none(batch_spec)),
-            P(),
+            table_spec,
             _prepend_none(P(axis)),
         ),
         out_specs=(P(axis), _prepend_none(_prepend_none(P(axis)))),
@@ -1146,7 +1269,8 @@ def build_fed_round_scan(
         return lax.scan(one_round, stacked_state, (batches, weights))
 
     return jax.jit(
-        sharded_rounds, donate_argnums=(0, 1) if donate_batch else (0,)
+        _reshard_state_out(sharded_rounds, state_shardings),
+        donate_argnums=(0, 1) if donate_batch else (0,),
     )
 
 
@@ -1168,6 +1292,7 @@ def build_news_update_step(
     cfg: ExperimentConfig,
     mesh: Mesh,
     strategy: FedStrategy | None = None,
+    state_shardings: Any | None = None,
 ) -> Callable:
     """Epoch-end news-head update for ``decoupled`` mode.
 
@@ -1221,7 +1346,10 @@ def build_news_update_step(
     def sharded_update(stacked_state, token_states):
         return _cohort_call(local_update, k, 1, stacked_state, token_states)
 
-    return jax.jit(sharded_update, donate_argnums=(0,))
+    return jax.jit(
+        _reshard_state_out(sharded_update, state_shardings),
+        donate_argnums=(0,),
+    )
 
 
 def compressed_sync_active(cfg: ExperimentConfig, strategy: FedStrategy) -> bool:
@@ -1370,7 +1498,10 @@ def _make_local_sync(
 
 
 def build_param_sync(
-    cfg: ExperimentConfig, mesh: Mesh, strategy: FedStrategy | None = None
+    cfg: ExperimentConfig,
+    mesh: Mesh,
+    strategy: FedStrategy | None = None,
+    state_shardings: Any | None = None,
 ) -> Callable:
     """Round-end parameter aggregation, dispatched through the strategy.
 
@@ -1403,7 +1534,7 @@ def build_param_sync(
                 local_sync, k, 4, stacked_state, weights, entry_u, entry_n
             )
 
-        return jax.jit(sharded_sync_c)
+        return jax.jit(_reshard_state_out(sharded_sync_c, state_shardings))
 
     @partial(
         shard_map,
@@ -1418,7 +1549,7 @@ def build_param_sync(
     # NOT donated (unlike the train step): sync runs once per round, so the
     # transient double-buffer is cheap, and callers legitimately hold the
     # pre-sync state for comparisons (e.g. the local-strategy identity test)
-    return jax.jit(sharded_sync)
+    return jax.jit(_reshard_state_out(sharded_sync, state_shardings))
 
 
 # --------------------------------------------------------------- eval step

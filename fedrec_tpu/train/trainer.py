@@ -157,6 +157,14 @@ class Trainer:
                 "ships a round update, so the codec would silently never "
                 "run (per-step grad_avg traffic is not compressed)"
             )
+        if cfg.shard.fsdp > 1 and cfg.fed.dcn_compress == "topk":
+            raise ValueError(
+                "fed.dcn_compress='topk' with shard.fsdp>1 is not "
+                "supported: the per-tensor top-k selection materializes "
+                "every gathered dense delta at the sync boundary, exactly "
+                "the full-size residency shard.fsdp exists to avoid — use "
+                "int8/sign1bit or shard.fsdp=1"
+            )
         self.chaos = None
         if cfg.chaos.enabled:
             from fedrec_tpu.fed.chaos import FaultPlan
@@ -208,6 +216,52 @@ class Trainer:
         else:
             self.token_states = jnp.asarray(
                 token_states, dtype=jnp.dtype(cfg.model.dtype)
+            )
+
+        # ---- sharding subsystem (fedrec_tpu.shard, docs/DESIGN.md §5i):
+        # (1) shard.table — the token-state catalog row-sharded over the
+        # client mesh axis; steps gather via the owner-bucketed all_to_all
+        # exchange, so catalog capacity scales with devices. (2) shard.fsdp
+        # — at-rest client state (params + optimizer moments + accumulators)
+        # sharded across the fsdp mesh axis per the size-aware policy,
+        # derived from the ABSTRACT state via jax.eval_shape so placement
+        # is known before any builder compiles. Both default off, and off
+        # means the byte-identical pre-shard programs.
+        self.table_spec = None
+        if cfg.shard.table:
+            from fedrec_tpu.shard.table import ShardedNewsTable, TableSpec
+
+            if self.token_states is not None:
+                tab = ShardedNewsTable.create(
+                    self.token_states, self.mesh, cfg.fed.mesh_axis
+                )
+                self.token_states = tab.rows
+                self.table_spec = tab.spec
+            else:
+                # finetune mode holds a token table, not cached states; the
+                # step builder below fails fast on the mode — this spec
+                # exists only to reach that guard
+                n = int(self.news_tokens.shape[0])
+                s = int(self.mesh.shape[cfg.fed.mesh_axis])
+                self.table_spec = TableSpec(
+                    cfg.fed.mesh_axis, s, -(-n // s), n
+                )
+        self._state_shardings = None
+        if cfg.shard.fsdp > 1:
+            from fedrec_tpu.shard.policy import fsdp_state_shardings
+
+            abstract_state = jax.eval_shape(
+                lambda: replicate_state(
+                    init_client_state(
+                        self.model, cfg, jax.random.PRNGKey(cfg.train.seed),
+                        data.num_news, data.title_len,
+                    ),
+                    cfg.fed.num_clients,
+                    jax.random.PRNGKey(cfg.train.seed + 1),
+                )
+            )
+            self._state_shardings = fsdp_state_shardings(
+                abstract_state, self.mesh, cfg
             )
 
         train_ix = index_samples(data.train_samples, data.nid2index, cfg.data.max_his_len)
@@ -342,6 +396,8 @@ class Trainer:
         self.train_step = build_fed_train_step(
             self.model, cfg, self.strategy, self.mesh, mode=self.mode,
             donate_batch=cfg.train.donate_batch,
+            sharded_table=self.table_spec,
+            state_shardings=self._state_shardings,
         )
         # epoch-in-jit chains (train.scan_steps > 1): one dispatch per
         # scan_steps batches; the tail of an epoch uses train_step
@@ -349,6 +405,8 @@ class Trainer:
             build_fed_train_scan(
                 self.model, cfg, self.strategy, self.mesh, mode=self.mode,
                 donate_batch=cfg.train.donate_batch,
+                sharded_table=self.table_spec,
+                state_shardings=self._state_shardings,
             )
             if cfg.train.scan_steps > 1
             else None
@@ -376,11 +434,17 @@ class Trainer:
             self.round_scan = build_fed_round_scan(
                 self.model, cfg, self.strategy, self.mesh, mode=self.mode,
                 donate_batch=cfg.train.donate_batch,
+                sharded_table=self.table_spec,
+                state_shardings=self._state_shardings,
             )
         self.news_update = build_news_update_step(
-            self.model, cfg, self.mesh, self.strategy
+            self.model, cfg, self.mesh, self.strategy,
+            state_shardings=self._state_shardings,
         )
-        self.param_sync = build_param_sync(cfg, self.mesh, self.strategy)
+        self.param_sync = build_param_sync(
+            cfg, self.mesh, self.strategy,
+            state_shardings=self._state_shardings,
+        )
         # codec syncs take the round-ENTRY params (the delta base) as extra
         # args — captured per round before the first buffer-donating step
         self._sync_takes_entry = compressed_sync_active(cfg, self.strategy)
@@ -405,10 +469,7 @@ class Trainer:
         stacked = replicate_state(
             state0, cfg.fed.num_clients, jax.random.PRNGKey(cfg.train.seed + 1)
         )
-        sharding = client_sharding(self.mesh, cfg.fed.mesh_axis)
-        self.state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), stacked
-        )
+        self.state = self._place_state(stacked)
         if self._pop_engine:
             # the pristine sidecar template a never-before-selected (or
             # quarantine-healed) logical client starts from: slot 0's
@@ -453,6 +514,9 @@ class Trainer:
                         "train.snapshot_dir at a fresh directory to start "
                         "over."
                     ) from e
+                # re-commit to the at-rest layout: a snapshot gathered to
+                # host on save (shard.fsdp) must land back sharded
+                self.state = self._place_state(self.state)
                 # last_restored_round, not latest_round(): a corrupt newest
                 # snapshot falls back to the previous retained one, and the
                 # resumed counter must match the state that actually loaded
@@ -621,6 +685,83 @@ class Trainer:
             "fused Pallas kernels per train step (0 = dense path)",
         )
         self._g_fused.set(fused_n)
+        # ---- sharding instruments (fedrec_tpu.shard; fedrec-obs report's
+        # Sharding section): always registered, zero-valued when the
+        # subsystem is off so the section simply doesn't render
+        self._g_fsdp_shards = self.registry.gauge(
+            "shard.fsdp_shards",
+            "fsdp mesh-axis size the at-rest state shards over (1 = "
+            "replicated layout)",
+        )
+        self._g_fsdp_shards.set(float(max(cfg.shard.fsdp, 1)))
+        self._g_state_bytes = self.registry.gauge(
+            "shard.state_bytes_per_device",
+            "at-rest client-state bytes ONE device holds under the active "
+            "sharding policy (params + optimizer moments + accumulators)",
+        )
+        self._g_table_rows = self.registry.gauge(
+            "shard.table_rows_per_device",
+            "news-catalog rows resident per device (= catalog rows under "
+            "the replicated layout; padded_rows / shards under shard.table)",
+        )
+        self._g_table_occ = self.registry.gauge(
+            "shard.table_occupancy",
+            "real catalog rows / padded sharded rows (1.0 = no padding "
+            "waste; only below 1 when devices don't divide the catalog)",
+        )
+        self._g_remote_rows = self.registry.gauge(
+            "shard.remote_gather_rows",
+            "worst-case rows crossing the interconnect per sharded-gather "
+            "step across the mesh (shards x unique slots; 0 = table "
+            "replicated, no remote gather)",
+        )
+        self._m_a2a_bytes = self.registry.counter(
+            "shard.a2a_bytes_total",
+            "modeled owner-bucketed all_to_all bytes of the sharded-table "
+            "gather (id buckets out + answer rows back, whole mesh), "
+            "advanced per dispatched step",
+        )
+        self._a2a_bytes_per_step = 0
+        if self.table_spec is not None:
+            from fedrec_tpu.shard.table import a2a_bytes_per_gather
+            from fedrec_tpu.train.step import resolve_unique_cap
+
+            spec = self.table_spec
+            b = cfg.data.batch_size
+            worst = b * (1 + cfg.data.npratio + cfg.data.max_his_len)
+            uniq = min(worst, spec.num_rows)
+            cap = resolve_unique_cap(cfg, b)
+            if cap:
+                uniq = min(uniq, cap)
+            self._a2a_bytes_per_step = a2a_bytes_per_gather(
+                uniq, tuple(self.token_states.shape[1:]),
+                self.token_states.dtype, spec,
+            )
+            self._g_table_rows.set(float(spec.rows_per_shard))
+            self._g_table_occ.set(spec.num_rows / spec.padded_rows)
+            self._g_remote_rows.set(float(spec.num_shards * uniq))
+        elif self.token_states is not None:
+            self._g_table_rows.set(float(self.token_states.shape[0]))
+            self._g_table_occ.set(1.0)
+        if self._state_shardings is not None:
+            from fedrec_tpu.shard.policy import shard_bytes_per_device
+
+            self._g_state_bytes.set(
+                float(shard_bytes_per_device(self.state, self._state_shardings))
+            )
+        else:
+            # replicated layout: every leaf is still dim-0 split over the
+            # clients axis (client_sharding), so ONE device's share is the
+            # total over that axis size — the same per-device accounting
+            # the fsdp branch reports, keeping the gauge comparable when
+            # an operator flips shard.fsdp on
+            total = sum(
+                float(np.prod(x.shape)) * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(self.state)
+            )
+            self._g_state_bytes.set(
+                total / int(self.mesh.shape[cfg.fed.mesh_axis])
+            )
         # ---- robustness instruments (fedrec-obs report's Robustness
         # section reads these): always registered — zero-valued when the
         # features are off, so the section simply doesn't render
@@ -847,6 +988,22 @@ class Trainer:
                 "train.snapshot_dir at a fresh directory."
             )
 
+    def _place_state(self, state: Any) -> Any:
+        """Commit a full state pytree to its at-rest layout: the per-leaf
+        FSDP shardings when ``shard.fsdp > 1`` (``shard.policy``), else the
+        classic leading-dim client sharding — THE one placement rule, used
+        by init, restore and adopt so a resumed run can never come back in
+        a layout the compiled programs would silently re-shard every step."""
+        if self._state_shardings is not None:
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(jnp.asarray(x), s),
+                state, self._state_shardings,
+            )
+        sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding), state
+        )
+
     def _client0_params(self) -> tuple[Any, Any]:
         u = jax.tree_util.tree_map(lambda x: x[0], self.state.user_params)
         n = jax.tree_util.tree_map(lambda x: x[0], self.state.news_params)
@@ -895,12 +1052,10 @@ class Trainer:
 
     def adopt_state(self, state: Any) -> None:
         """Install a restored full state pytree (params + opt + PRNG) with
-        the trainer's client sharding — the multi-process resume path, where
-        snapshots are flax-serialized per host rather than orbax-managed."""
-        sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
-        self.state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), sharding), state
-        )
+        the trainer's at-rest layout (``_place_state``) — the multi-process
+        resume path, where snapshots are flax-serialized per host rather
+        than orbax-managed."""
+        self.state = self._place_state(state)
         self._table = None  # params changed; a cached decoupled table is stale
 
     def set_global_params(self, user_params: Any, news_params: Any) -> None:
@@ -974,6 +1129,14 @@ class Trainer:
         corpus scale). The result is pinned replicated so every consumer —
         train step (in_spec ``P()``), per-batch eval gathers, serving
         export — pays the post-encode all-gather exactly once here."""
+        if self.table_spec is not None:
+            # sharded catalog: the at-rest rows are already P(clients) and
+            # padded, so the sharded encode reshards nothing; only the REAL
+            # rows leave (eval/serving index by catalog id)
+            vecs = encode_all_news_sharded(
+                self.model, news_params, self.token_states, self.mesh
+            )
+            return self._replicate_table(vecs[: self.table_spec.num_rows])
         if self.mesh.size > 1:
             return self._replicate_table(
                 encode_all_news_sharded(
@@ -1630,14 +1793,25 @@ class Trainer:
 
                 jax.tree_util.tree_map(put, fields[f], sc[f])
         self._m_cohort_swaps.inc(len(changed))
-        sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
-        self.state = self.state.replace(**{
-            f: jax.tree_util.tree_map(
-                lambda x: jax.device_put(jnp.asarray(x), sharding),
-                fields[f],
-            )
-            for f in SIDECAR_FIELDS
-        })
+        if self._state_shardings is not None:
+            # fsdp at rest: each sidecar field re-commits to its policy
+            # layout, not the flat client sharding
+            self.state = self.state.replace(**{
+                f: jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(jnp.asarray(x), s),
+                    fields[f], getattr(self._state_shardings, f),
+                )
+                for f in SIDECAR_FIELDS
+            })
+        else:
+            sharding = client_sharding(self.mesh, self.cfg.fed.mesh_axis)
+            self.state = self.state.replace(**{
+                f: jax.tree_util.tree_map(
+                    lambda x: jax.device_put(jnp.asarray(x), sharding),
+                    fields[f],
+                )
+                for f in SIDECAR_FIELDS
+            })
         self._slot_occupants = new_occ.copy()
         self._slot_writeback = new_wb
 
@@ -1767,6 +1941,15 @@ class Trainer:
             ),
         }
 
+    def _count_steps(self, n: int) -> None:
+        """Step counter + the sharded-gather wire model: every dispatched
+        step moves one owner-bucketed exchange across the mesh when the
+        catalog is sharded (``shard.a2a_bytes_total``; 0 bytes/step when
+        ``shard.table`` is off)."""
+        self._m_steps.inc(n)
+        if self._a2a_bytes_per_step:
+            self._m_a2a_bytes.inc(float(n * self._a2a_bytes_per_step))
+
     def _chaos_batch_keys(self, round_idx: int) -> dict | None:
         """Per-client fault vectors every chaos-enabled batch must carry
         (``train.step`` applies them at the update boundary)."""
@@ -1851,7 +2034,7 @@ class Trainer:
                 health_rows.append(row)
 
         def dispatch(group: list, table) -> None:
-            self._m_steps.inc(len(group))
+            self._count_steps(len(group))
             if len(group) == scan_s and scan_s > 1:
                 with tracer.span("h2d", n=len(group)):
                     stacked = shard_scan_batches(
@@ -2166,7 +2349,7 @@ class Trainer:
             stacked = shard_round_batches(
                 self.mesh, stack_rounds(round_lists), cfg
             )
-        self._m_steps.inc(num_rounds * steps)
+        self._count_steps(num_rounds * steps)
         with tracer.span(
             "dispatch", kind="round_chunk", rounds=num_rounds, steps=steps
         ):

@@ -1,0 +1,156 @@
+"""Unit tests for the size-aware FSDP sharding policy (shard/policy.py).
+
+The SNIPPETS [2] rule on hand-built pytrees: threshold, 1-D replicate,
+no-divisible-dim fallback, largest-dim selection, and the fsdp=1 ==
+replicated degenerate contract — plus the stacked-state form the Trainer
+derives via jax.eval_shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedrec_tpu.shard.policy import (
+    FSDP_AXIS,
+    fsdp_leaf_sharding,
+    fsdp_shardings,
+    fsdp_state_shardings,
+    shard_bytes_per_device,
+)
+
+
+def fsdp_mesh(n_fsdp: int, n_cli: int = 1) -> Mesh:
+    devs = np.array(jax.devices()[: n_cli * n_fsdp]).reshape(n_cli, n_fsdp)
+    return Mesh(devs, ("clients", FSDP_AXIS))
+
+
+def leaf(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+
+
+def test_scalars_and_1d_replicate():
+    mesh = fsdp_mesh(2)
+    assert fsdp_leaf_sharding(leaf(()), mesh, 0.0).spec == P()
+    assert fsdp_leaf_sharding(leaf((1024,)), mesh, 0.0).spec == P()
+
+
+def test_small_arrays_replicate_threshold():
+    mesh = fsdp_mesh(2)
+    # 64x64 f32 = 16 KB < 1 MB threshold -> replicated
+    assert fsdp_leaf_sharding(leaf((64, 64)), mesh, 1.0).spec == P()
+    # threshold 0 -> sharded
+    assert fsdp_leaf_sharding(leaf((64, 64)), mesh, 0.0).spec != P()
+
+
+def test_shards_largest_evenly_divisible_dim():
+    mesh = fsdp_mesh(2)
+    assert fsdp_leaf_sharding(leaf((8, 4)), mesh, 0.0).spec == P(FSDP_AXIS, None)
+    assert fsdp_leaf_sharding(leaf((3, 8)), mesh, 0.0).spec == P(None, FSDP_AXIS)
+    # largest dim not divisible, smaller one is -> falls through to it
+    assert fsdp_leaf_sharding(leaf((9, 4)), mesh, 0.0).spec == P(None, FSDP_AXIS)
+
+
+def test_no_divisible_dim_falls_back_to_replicated():
+    mesh = fsdp_mesh(2)
+    assert fsdp_leaf_sharding(leaf((3, 5)), mesh, 0.0).spec == P()
+
+
+def test_fsdp_size_one_replicates_everything():
+    mesh = fsdp_mesh(1)
+    for shape in ((), (7,), (8, 8), (1024, 1024)):
+        assert fsdp_leaf_sharding(leaf(shape), mesh, 0.0).spec == P()
+
+
+def test_tree_form_and_eval_shape_leaves():
+    mesh = fsdp_mesh(2)
+    tree = {"w": leaf((8, 8)), "b": leaf((8,)), "odd": leaf((3, 5))}
+    sh = fsdp_shardings(tree, mesh, min_size_mbytes=0.0)
+    # square leaf: the snippet's argsort[::-1] tie-break picks the LAST
+    # of the equally-largest dims
+    assert sh["w"].spec == P(None, FSDP_AXIS)
+    assert sh["b"].spec == P()
+    assert sh["odd"].spec == P()
+
+
+def test_state_shardings_pin_client_axis_and_off_switch():
+    from fedrec_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig()
+    cfg.fed.num_clients = 4
+    cfg.shard.fsdp = 2
+    cfg.shard.fsdp_min_size_mb = 0.0
+    mesh = fsdp_mesh(2, n_cli=4)
+
+    class FakeState:
+        pass
+
+    tree = {"p": leaf((4, 16, 8)), "s": leaf((4,))}
+    sh = fsdp_state_shardings(tree, mesh, cfg)
+    assert sh["p"].spec == P("clients", FSDP_AXIS, None)
+    assert sh["s"].spec == P("clients")
+
+    cfg.shard.fsdp = 1
+    assert fsdp_state_shardings(tree, mesh, cfg) is None
+    # a mesh without the fsdp axis also disables the policy
+    cfg.shard.fsdp = 2
+    flat = Mesh(np.array(jax.devices()[:4]), ("clients",))
+    assert fsdp_state_shardings(tree, flat, cfg) is None
+
+
+def test_shard_bytes_per_device_counts_the_split():
+    mesh = fsdp_mesh(2, n_cli=4)
+    tree = {"p": leaf((4, 16, 8)), "s": leaf((4,))}
+    from fedrec_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig()
+    cfg.fed.num_clients = 4
+    cfg.shard.fsdp = 2
+    cfg.shard.fsdp_min_size_mb = 0.0
+    sh = fsdp_state_shardings(tree, mesh, cfg)
+    # p: 4*16*8*4 bytes over clients(4) x fsdp(2); s: 4*4 over clients(4)
+    expected = (4 * 16 * 8 * 4) / 8 + (4 * 4) / 4
+    assert shard_bytes_per_device(tree, sh) == int(expected)
+
+
+def test_eval_shape_derivation_matches_concrete():
+    """The Trainer derives shardings from jax.eval_shape of the stacked
+    init — structure and per-leaf specs must match the concrete state's."""
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.train.state import init_client_state, replicate_state
+
+    cfg = ExperimentConfig()
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    cfg.model.bert_hidden = 48
+    cfg.model.text_encoder_mode = "head"
+    cfg.data.max_title_len = 12
+    cfg.fed.num_clients = 4
+    cfg.shard.fsdp = 2
+    cfg.shard.fsdp_min_size_mb = 0.0
+    mesh = fsdp_mesh(2, n_cli=4)
+    model = NewsRecommender(cfg.model)
+
+    def build():
+        return replicate_state(
+            init_client_state(model, cfg, jax.random.PRNGKey(0), 64, 12),
+            cfg.fed.num_clients, jax.random.PRNGKey(1),
+        )
+
+    abstract = jax.eval_shape(build)
+    concrete = build()
+    sh_a = fsdp_state_shardings(abstract, mesh, cfg)
+    sh_c = fsdp_state_shardings(concrete, mesh, cfg)
+    la, lc = jax.tree_util.tree_leaves(sh_a), jax.tree_util.tree_leaves(sh_c)
+    assert len(la) == len(lc)
+    for a, c in zip(la, lc):
+        assert a.spec == c.spec
+    # at least one 2-D+ leaf actually sharded over fsdp
+    assert any(FSDP_AXIS in str(s.spec) for s in la)
