@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke comm-cost pallas-bench table-capacity
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -33,6 +33,14 @@ chaos-smoke:
 # table, and fsdp at-rest sharding with cross-process-identical losses
 shard-smoke:
 	@bash scripts/shard_smoke.sh
+
+# elastic-federation smoke: a 4-process gloo world under epoch-based
+# membership loses one peer to a chaos kill, shrinks-and-continues at
+# world 3, reintegrates the supervisor-respawned peer at world 4,
+# finishes every round + the final eval, and the membership counters
+# match the script (exactly one shrink, one rejoin, worlds 4 -> 3 -> 4)
+elastic-smoke:
+	@bash scripts/elastic_smoke.sh
 
 # catalog-capacity benchmark: rows-per-device x devices frontier
 # (replicated vs sharded) + a measured sharded-gather exactness/latency

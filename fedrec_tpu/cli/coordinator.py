@@ -38,6 +38,29 @@ from fedrec_tpu.cli.run import build_parser
 RESPAWN_EXIT = 75
 
 
+def _argv_value(tokens: list[str], flag: str) -> str | None:
+    """The value of ``--flag X`` / ``--flag=X`` in an argv slice, or None."""
+    for i, tok in enumerate(tokens):
+        if tok == flag and i + 1 < len(tokens):
+            return tokens[i + 1]
+        if tok.startswith(flag + "="):
+            return tok.split("=", 1)[1]
+    return None
+
+
+def _membership_status(address: str) -> dict | None:
+    """Best-effort status query against the membership service (the
+    supervisor's handshake source); None when unreachable."""
+    try:
+        from fedrec_tpu.parallel.membership import MembershipClient
+
+        return MembershipClient(
+            address, worker_id="_supervisor", rpc_timeout_s=5.0
+        ).status()
+    except Exception:  # noqa: BLE001 — a down service must not stop respawns
+        return None
+
+
 def _supervise(argv: list[str]) -> int:
     """``--supervise``: wrap the worker in an auto-respawn loop.
 
@@ -58,6 +81,17 @@ def _supervise(argv: list[str]) -> int:
     service address for the new world. ``FEDREC_SUPERVISE_MAX`` (default
     20) bounds the respawn budget; ``FEDREC_WORKER_PIDFILE`` (if set)
     receives the live worker's pid, so chaos tooling can kill it.
+
+    Elastic handshake (``--membership``): before every (re)spawn the
+    supervisor queries the membership service and hands the child the
+    CURRENT epoch via ``FEDREC_MEMBERSHIP_EPOCH`` — and when the service
+    shows a reformation already in progress (epoch advanced since the
+    child started, joiners parked, or reform pending) the backoff is cut
+    to ~1s: the rc-75 exit IS the reformation protocol, so making the
+    child wait out a crash-grade backoff would stall the forming epoch
+    for every other member. Without the handshake a respawned child
+    re-execs into whatever rendezvous it last knew — the dead world —
+    and loops.
     """
     import random
     import subprocess
@@ -66,6 +100,8 @@ def _supervise(argv: list[str]) -> int:
     keep = [t for t in argv if t != "--supervise"]
     env = dict(os.environ, FEDREC_SUPERVISED="1")
     pidfile = os.environ.get("FEDREC_WORKER_PIDFILE")
+    membership_addr = _argv_value(keep, "--membership")
+    last_epoch: int | None = None
     base_delay = 5.0
     for i, tok in enumerate(keep):
         val = None
@@ -82,6 +118,11 @@ def _supervise(argv: list[str]) -> int:
     rng = random.Random(os.getpid())
     attempt = 0
     while True:
+        if membership_addr:
+            st = _membership_status(membership_addr)
+            if st is not None:
+                env["FEDREC_MEMBERSHIP_EPOCH"] = str(st["epoch"])
+                last_epoch = int(st["epoch"])
         proc = subprocess.Popen(
             [sys.executable, "-m", "fedrec_tpu.cli.coordinator", *keep],
             env=env,
@@ -119,6 +160,17 @@ def _supervise(argv: list[str]) -> int:
             return rc if rc > 0 else 1
         delay = min(base_delay * (1.5 ** min(attempt - 1, 6)), 60.0)
         delay *= 0.5 + rng.random()  # jitter: desynchronize peer supervisors
+        if membership_addr:
+            st = _membership_status(membership_addr)
+            reforming = st is not None and (
+                st.get("reform_pending")
+                or st.get("pending")
+                or (last_epoch is not None and int(st["epoch"]) != last_epoch)
+            )
+            if reforming:
+                # the exit was the reformation protocol, not a crash: the
+                # forming epoch is waiting on this worker's join
+                delay = 0.5 + rng.random()
         print(
             f"[supervisor] worker exited rc={rc}; respawn "
             f"{attempt}/{max_respawns} in {delay:.1f}s",
@@ -170,6 +222,14 @@ def main(argv: list[str] | None = None) -> int:
                              "supervisor: a died/killed worker (or a broken "
                              "world) relaunches and rejoins through the "
                              "elastic resume path without operator action")
+    parser.add_argument("--membership", default=None, metavar="HOST:PORT",
+                        help="elastic membership service "
+                             "(fedrec_tpu.parallel.membership): the world "
+                             "size becomes a membership EPOCH — peer loss "
+                             "shrinks-and-continues, a respawned peer "
+                             "rejoins at the next epoch boundary. "
+                             "--process-id is then the stable worker "
+                             "identity; requires --supervise")
     original_argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
     if args.supervise:
@@ -177,11 +237,100 @@ def main(argv: list[str] | None = None) -> int:
     supervised = os.environ.get("FEDREC_SUPERVISED") == "1"
 
     from fedrec_tpu.parallel.multihost import (
+        REFORM_SIGNAL,
         CoordinatorRuntime,
         initialize_distributed,
     )
 
-    if args.coordinator is not None:
+    membership = None
+    assignment = None
+    if args.membership is not None:
+        if args.process_id is None:
+            parser.error("--membership requires --process-id (the stable "
+                         "worker identity snapshots are keyed by)")
+        if not supervised:
+            parser.error(
+                "--membership requires --supervise: reforming an epoch "
+                "LEAVES the process (rc 75) and only the supervisor can "
+                "rejoin it at the next epoch"
+            )
+        from fedrec_tpu.config import ExperimentConfig as _PreCfg
+        from fedrec_tpu.fed.chaos import rejoin_holdoff
+        from fedrec_tpu.parallel.membership import (
+            MembershipClient,
+            MembershipError,
+            elastic_policy,
+            publish_membership_metrics,
+        )
+
+        # elastic + chaos knobs are needed BEFORE the full config build
+        # (which touches jax and must wait for the rendezvous); config
+        # parsing itself is jax-free
+        pre_cfg = _PreCfg()
+        pre_cfg.apply_overrides(args.overrides)
+        el = pre_cfg.fed.elastic
+        holdoff = rejoin_holdoff(
+            pre_cfg.chaos, args.process_id,
+            Path(pre_cfg.train.snapshot_dir or "snapshots"),
+        )
+        if holdoff > 0:
+            import time as _time
+
+            print(
+                f"[chaos] worker {args.process_id} holding off its rejoin "
+                f"{holdoff:.0f}s (chaos.rejoin_delay_s) so the survivors' "
+                "shrunk epoch forms first",
+                flush=True,
+            )
+            _time.sleep(holdoff)
+        membership = MembershipClient(
+            args.membership, worker_id=str(args.process_id),
+            join_timeout_s=el.join_timeout_s,
+        )
+        handed = os.environ.get("FEDREC_MEMBERSHIP_EPOCH")
+        try:
+            assignment = membership.join(policy=elastic_policy(el))
+        except (OSError, MembershipError, ValueError) as e:
+            # a join that cannot complete (service briefly down, formation
+            # waiting on a member that has not reached its boundary yet)
+            # is retryable by definition under supervision
+            print(
+                f"[membership] worker {args.process_id} join failed "
+                f"({type(e).__name__}: {e}); exiting for retry "
+                f"(rc {RESPAWN_EXIT})",
+                flush=True,
+            )
+            sys.exit(RESPAWN_EXIT)
+        print(
+            f"[membership] worker {args.process_id} joined epoch "
+            f"{assignment.epoch} as rank {assignment.rank}/"
+            f"{assignment.world} (coordinator {assignment.coordinator}"
+            + (f"; supervisor handed epoch {handed}" if handed else "")
+            + ")",
+            flush=True,
+        )
+        # heartbeats start BEFORE the rendezvous: leases began ticking at
+        # formation, and bring-up (transport probe included) can outlast
+        # lease_ms — a late first renewal would read as a death and
+        # reform the world that just formed
+        membership.start_heartbeat()
+        try:
+            publish_membership_metrics(
+                assignment=assignment, client=membership,
+                status=membership.status(),
+            )
+        except (OSError, MembershipError):
+            publish_membership_metrics(assignment=assignment, client=membership)
+
+    coordinator_address = args.coordinator
+    world_processes = args.num_processes
+    world_rank = args.process_id
+    if assignment is not None:
+        coordinator_address = assignment.coordinator
+        world_processes = assignment.world
+        world_rank = assignment.rank
+
+    if coordinator_address is not None:
         # supervised relaunches get a BOUNDED rendezvous: a respawn racing
         # the old (dying) world must fail fast and let the supervisor retry
         init_timeout = None
@@ -189,7 +338,7 @@ def main(argv: list[str] | None = None) -> int:
             init_timeout = max(30.0, min(args.collective_timeout * 2, 120.0))
         try:
             initialize_distributed(
-                args.coordinator, args.num_processes, args.process_id,
+                coordinator_address, world_processes, world_rank,
                 initialization_timeout=init_timeout,
             )
         except Exception as e:  # noqa: BLE001 — supervised rendezvous
@@ -229,6 +378,16 @@ def main(argv: list[str] | None = None) -> int:
         cfg.data.dataset = "synthetic"
     cfg.apply_overrides(args.overrides)
 
+    if membership is not None:
+        cfg.fed.elastic.enabled = True  # config.json provenance
+    elif cfg.fed.elastic.enabled:
+        raise ValueError(
+            "fed.elastic.enabled is set but no membership service was "
+            "given: pass --membership HOST:PORT (and run under "
+            "--supervise) — the epoch layer cannot form without the "
+            "lease service"
+        )
+
     if cfg.fed.robust.method != "mean" and cfg.fed.dcn_compress != "none":
         # robust x compress is LEGAL for every registered codec: the gather
         # decodes each contribution per process BEFORE any reduction
@@ -267,6 +426,8 @@ def main(argv: list[str] | None = None) -> int:
             cfg.fed.population.round_deadline_ms / 1e3
             if cfg.fed.population.round_deadline_ms > 0 else None
         ),
+        membership=membership,
+        epoch=assignment.epoch if assignment is not None else 0,
     )
     apply_process_sharding(cfg, rt, args.server_trains)
 
@@ -282,9 +443,34 @@ def main(argv: list[str] | None = None) -> int:
     if Path(token_path).exists():
         token_states = np.load(token_path)
     else:
-        token_states = np.random.default_rng(0).standard_normal(
-            (data.num_news, data.title_len, cfg.model.bert_hidden)
-        ).astype(np.float32)
+        token_states = None
+        if membership is not None and cfg.shard.table:
+            # sharded-catalog recovery: a (re)joined worker whose token
+            # source is gone reloads the frozen rows from the last table
+            # checkpoint (save cadence below) instead of losing them —
+            # the no-rows-lost half of shrink-and-continue
+            from fedrec_tpu.train.checkpoint import load_table_checkpoint
+
+            token_states = load_table_checkpoint(
+                Path(cfg.train.snapshot_dir or "snapshots")
+            )
+            if token_states is not None:
+                from fedrec_tpu.obs import get_registry
+
+                get_registry().counter(
+                    "shard.reshard_rows_recovered_total",
+                    "catalog rows reloaded from the table checkpoint "
+                    "across membership epoch changes",
+                ).inc(float(token_states.shape[0]))
+                print(
+                    f"[membership] worker {args.process_id} recovered "
+                    f"{token_states.shape[0]} catalog rows from the table "
+                    "checkpoint"
+                )
+        if token_states is None:
+            token_states = np.random.default_rng(0).standard_normal(
+                (data.num_news, data.title_len, cfg.model.bert_hidden)
+            ).astype(np.float32)
 
     if args.dp_epsilon > 0:
         cfg.privacy.enabled = True
@@ -305,8 +491,21 @@ def main(argv: list[str] | None = None) -> int:
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
     local_snap = None
     # a degraded-mode respawn is a standalone process that must keep the
-    # multi-process msgpack snapshot flavor (it continues ITS shard's run)
-    msgpack_snapshots = rt.num_processes > 1 or args.resume_local_state
+    # multi-process msgpack snapshot flavor (it continues ITS shard's run);
+    # so must an elastic world shrunk to 1 — the next epoch may grow back
+    msgpack_snapshots = (
+        rt.num_processes > 1 or args.resume_local_state
+        or membership is not None
+    )
+    # snapshot identity: under elastic membership the STABLE worker id
+    # (ranks are re-dealt every epoch, so rank-keyed files would adopt a
+    # different worker's state after a reshuffle); the rank otherwise —
+    # the unchanged pre-elastic naming
+    ident = int(args.process_id) if membership is not None else rt.process_id
+    state_suffix = (
+        f"w{args.process_id}" if membership is not None
+        else f"p{rt.process_id}"
+    )
     if msgpack_snapshots:
         # orbax snapshots assume whole-world coordination; in the coordinator
         # deployment each process instead flax-serializes its FULL local
@@ -344,7 +543,7 @@ def main(argv: list[str] | None = None) -> int:
         # resumed run keeps carrying the mass its encodes dropped. A
         # missing/corrupt sidecar just starts the residual from zero — the
         # same bounded-staleness contract as a fresh logical client.
-        codec_snap = snapshot_dir / f"codec_state_p{rt.process_id}.npz"
+        codec_snap = snapshot_dir / f"codec_state_{state_suffix}.npz"
         if cfg.train.resume and codec_snap.exists():
             from fedrec_tpu.comms import load_codec_state
 
@@ -372,9 +571,12 @@ def main(argv: list[str] | None = None) -> int:
         local_snap = (
             Path(args.resume_local_state)
             if args.resume_local_state
-            else snapshot_dir / f"local_state_p{rt.process_id}.msgpack"
+            else snapshot_dir / f"local_state_{state_suffix}.msgpack"
         )
         if cfg.train.resume and local_snap.exists():
+            import time as _time
+
+            reshard_t0 = _time.perf_counter()
             template = {"state": trainer.state, "round": 0}
             try:
                 restored = serialization.from_bytes(
@@ -401,6 +603,39 @@ def main(argv: list[str] | None = None) -> int:
                     f"[coordinator] process {rt.process_id} resumed local state "
                     f"at round {trainer.start_round - 1}"
                 )
+            if membership is not None:
+                # epoch-boundary reshard: the restore above re-committed
+                # the hand-off state to THIS epoch's mesh/world layout
+                # (Trainer._place_state re-derives placement, the data
+                # shards re-dealt at apply_process_sharding) — publish how
+                # long the hand-off cost
+                from fedrec_tpu.obs import get_registry
+
+                get_registry().gauge(
+                    "shard.reshard_seconds",
+                    "wall seconds the last membership-epoch state "
+                    "hand-off took (restore + re-placement)",
+                ).set(_time.perf_counter() - reshard_t0)
+        if membership is not None and cfg.train.resume:
+            # participation-ledger continuity across epochs: the per-worker
+            # population sidecar re-adopts with resize tolerance (the
+            # re-formed world may deal different local data)
+            pop_snap = snapshot_dir / f"population_state_{state_suffix}.msgpack"
+            if pop_snap.exists() and trainer._pop_engine:
+                try:
+                    pop_round = trainer.adopt_population_sidecar(
+                        pop_snap.read_bytes(), resize=True
+                    )
+                    print(
+                        f"[membership] worker {args.process_id} carried its "
+                        f"participation ledger from round {pop_round}"
+                    )
+                except Exception as e:  # noqa: BLE001 — a torn sidecar
+                    # costs history, never the resume
+                    print(
+                        f"[membership] population sidecar unreadable "
+                        f"({type(e).__name__}: {e}); ledger restarts fresh"
+                    )
         if cfg.fed.server_opt != "none":
             # cross-host FedOpt is hub-and-spoke: ONLY the server holds and
             # steps the optimizer (the FedOpt paper's topology); clients
@@ -502,6 +737,99 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.flush()
         os.execv(sys.executable, cmd)
 
+    def _dump_obs_artifacts() -> None:
+        """Flush the registry/trace into ``obs.dir`` on the coordinator
+        CLI's exit paths (reform + finish): unlike Trainer.run, this loop
+        never writes registry snapshots itself, so without a final dump
+        the membership/reshard gauges would never reach the artifacts
+        `fedrec-obs report` reads."""
+        if not cfg.obs.dir:
+            return
+        from fedrec_tpu.obs import dump_artifacts
+
+        try:
+            dump_artifacts(Path(cfg.obs.dir) / f"worker_{ident}")
+        except OSError as e:
+            print(f"[coordinator] obs artifact dump failed: {e}")
+
+    def save_elastic_sidecars(round_tag: int) -> None:
+        """Membership-mode extras that ride every state save: the
+        per-worker population sidecar (participation-ledger continuity
+        across epochs) and the one-time table checkpoint (the sharded
+        catalog's row-recovery source)."""
+        if membership is None:
+            return
+        from fedrec_tpu.train.checkpoint import (
+            NEWS_TABLE_CHECKPOINT,
+            atomic_write_bytes,
+            save_table_checkpoint,
+        )
+
+        pop_blob = trainer.population_sidecar_bytes(round_tag)
+        if pop_blob is not None:
+            atomic_write_bytes(
+                snapshot_dir / f"population_state_{state_suffix}.msgpack",
+                pop_blob,
+            )
+        if cfg.shard.table and not (
+            snapshot_dir / NEWS_TABLE_CHECKPOINT
+        ).exists():
+            save_table_checkpoint(snapshot_dir, token_states)
+
+    def reform_handoff(next_round: int) -> None:
+        """The reformation barrier's worker half: every member received
+        :data:`REFORM_SIGNAL` in the SAME round broadcast, so the whole
+        world executes this at one boundary — save the full local state
+        (round-tagged hand-off snapshot the next epoch resumes from,
+        bit-identical for the unchanged part of the world), tear the old
+        runtime down while it is still healthy, and exit with the
+        retryable status so the supervisor rejoins the forming epoch."""
+        print(
+            f"[membership] worker {args.process_id} leaving epoch "
+            f"{rt.epoch} at round boundary {next_round} for reformation",
+            flush=True,
+        )
+        if local_snap is not None:
+            from flax import serialization
+            from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+            snapshot_dir.mkdir(parents=True, exist_ok=True)
+            atomic_write_bytes(
+                local_snap,
+                serialization.to_bytes(
+                    {"state": trainer.state, "round": next_round - 1}
+                ),
+            )
+            if server_optimizer is not None:
+                atomic_write_bytes(
+                    snapshot_dir / "server_opt_state.msgpack",
+                    server_optimizer.state_bytes(next_round - 1),
+                )
+            if codec_snap is not None:
+                from fedrec_tpu.comms import codec_state_bytes
+
+                atomic_write_bytes(
+                    codec_snap, codec_state_bytes(rt.codec_state, next_round - 1)
+                )
+            save_elastic_sidecars(next_round - 1)
+        from fedrec_tpu.parallel.membership import publish_membership_metrics
+
+        try:
+            publish_membership_metrics(
+                reforms=1, client=membership, status=membership.status()
+            )
+        except Exception:  # noqa: BLE001 — a mute service can't block reform
+            publish_membership_metrics(reforms=1)
+        _dump_obs_artifacts()
+        trainer.logger.finish()
+        # the world is HEALTHY here (the reform broadcast just completed),
+        # so the synchronized teardown applies: coordination service and
+        # gloo pairs close cleanly before every member leaves
+        rt._synchronized_shutdown()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(RESPAWN_EXIT)
+
     round_idx = trainer.start_round
     while True:
         # negotiate the round: everyone adopts the SERVER's counter (a host
@@ -510,6 +838,8 @@ def main(argv: list[str] | None = None) -> int:
         server_round = rt.start_round(round_idx, cfg.fed.rounds)
         if rt.degraded:
             respawn_standalone()
+        if server_round == REFORM_SIGNAL:
+            reform_handoff(round_idx)
         if server_round < 0:
             break
         round_idx = server_round
@@ -521,14 +851,16 @@ def main(argv: list[str] | None = None) -> int:
         if (
             cfg.chaos.enabled
             and cfg.chaos.kill_round == round_idx
-            and cfg.chaos.kill_process == rt.process_id
+            # under elastic membership the kill targets the STABLE worker
+            # identity (ranks re-deal every epoch)
+            and cfg.chaos.kill_process == ident
         ):
             marker_dir = (
                 snapshot_dir if msgpack_snapshots
                 else Path(cfg.train.snapshot_dir or "snapshots")
             )
             marker_dir.mkdir(parents=True, exist_ok=True)
-            marker = marker_dir / f"chaos_killed_p{rt.process_id}"
+            marker = marker_dir / f"chaos_killed_p{ident}"
             if not marker.exists():
                 marker.write_text(str(round_idx))
                 print(
@@ -644,6 +976,7 @@ def main(argv: list[str] | None = None) -> int:
                         codec_snap,
                         codec_state_bytes(rt.codec_state, round_idx),
                     )
+                save_elastic_sidecars(round_idx)
                 if rt.is_server and rt.num_processes > 1:
                     # a degraded-mode respawn (single process) is a CLIENT
                     # continuation — its params are NOT the global model
@@ -663,6 +996,20 @@ def main(argv: list[str] | None = None) -> int:
     print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
     if trainer.snapshots is not None:
         trainer.snapshots.wait()  # settle async saves before any exit path
+    if membership is not None:
+        # a finished run LEAVES (no lease to expire, no reform): the
+        # service's final status must read completion, not death
+        from fedrec_tpu.parallel.membership import publish_membership_metrics
+
+        try:
+            publish_membership_metrics(
+                status=membership.status(), client=membership
+            )
+        except Exception:  # noqa: BLE001 — metrics must not block the exit
+            pass
+        membership.leave()
+        membership.close()
+        _dump_obs_artifacts()
     trainer.logger.finish()  # before finalize: os._exit skips teardown
     rt.finalize(0)  # no-op unless the world broke mid-run (then exits here)
     return 0

@@ -255,6 +255,37 @@ class PopulationConfig:
 
 
 @dataclass
+class ElasticConfig:
+    """Elastic membership (``fedrec_tpu.parallel.membership``).
+
+    Activated by ``fedrec-coordinator --membership HOST:PORT`` (which sets
+    ``enabled``): the deployment's world size stops being the static
+    ``--num-processes`` and becomes a *membership epoch* maintained by a
+    lease service. A dead peer shrinks the world at the next epoch
+    boundary (shrink-and-continue — survivors keep federating instead of
+    each degrading to standalone); a supervisor-respawned peer rejoins at
+    the next boundary and the world grows back. A run whose membership
+    never changes is bit-identical to the fixed world.
+
+    ``lease_ms`` is how long a silent worker stays a member (the failure
+    detector; size it above the worst-case round time so a slow round is
+    not a death), ``heartbeat_ms`` the renewal cadence (≤ lease/3),
+    ``formation_grace_ms`` how long a forming epoch waits for stragglers
+    before continuing with fewer (the shrink window), ``min_world`` the
+    floor below which no epoch forms (survivors then keep waiting),
+    ``join_timeout_s`` how long a joining worker parks before its
+    supervisor retries.
+    """
+
+    enabled: bool = False
+    lease_ms: float = 15000.0
+    heartbeat_ms: float = 5000.0
+    formation_grace_ms: float = 10000.0
+    min_world: int = 1
+    join_timeout_s: float = 180.0
+
+
+@dataclass
 class ShardConfig:
     """Model/catalog sharding (``fedrec_tpu.shard``) — scale state past
     per-device HBM.
@@ -358,6 +389,10 @@ class FedConfig:
     # cross-device cohort engine: logical-client population sampled onto
     # the device slots each round (see PopulationConfig).
     population: PopulationConfig = field(default_factory=PopulationConfig)
+    # elastic membership: epoch-based world formation over heartbeat
+    # leases — shrink-and-continue on peer loss, rejoin at epoch
+    # boundaries (see ElasticConfig).
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
 
 @dataclass
@@ -497,6 +532,13 @@ class ChaosConfig:
     kill_round: int = -1               # process exits hard at this round's entry
     kill_process: int = -1             #   which coordinator process dies
     torn_snapshot_round: int = -1      # truncate the just-written local snapshot
+    # elastic kill->shrink->rejoin scripting: after the chaos kill, the
+    # respawned worker HOLDS OFF joining the membership service for this
+    # many seconds (once, marker-guarded), so the survivors demonstrably
+    # form the SHRUNK epoch first and the rejoin lands as its own later
+    # epoch — without it a fast respawn can race straight back into the
+    # formation window and the shrink never becomes observable. 0 = off.
+    rejoin_delay_s: float = 0.0
 
 
 @dataclass

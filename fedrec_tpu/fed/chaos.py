@@ -188,6 +188,38 @@ class FaultPlan:
         return population_report(self, round_idx, client_ids, attempt)
 
 
+def rejoin_holdoff(chaos_cfg: Any, worker_id: int, marker_dir) -> float:
+    """Kill->shrink->rejoin scripting for the elastic deployment: the
+    seconds a respawned, chaos-killed worker should wait BEFORE rejoining
+    the membership service (``chaos.rejoin_delay_s``), or 0.
+
+    Marker-guarded like the kill itself: only the worker named by
+    ``chaos.kill_process``, only AFTER its kill marker exists (it actually
+    died), and only ONCE (``chaos_rejoin_delayed_p<ID>`` written on the
+    first holdoff) — later reform-driven respawns of the same worker
+    rejoin immediately. The holdoff is what makes the shrink epoch
+    observable before the rejoin epoch: without it a fast respawn races
+    straight back into the survivors' formation window and the world
+    re-forms at full size in one step.
+    """
+    from pathlib import Path
+
+    if (
+        not getattr(chaos_cfg, "enabled", False)
+        or float(getattr(chaos_cfg, "rejoin_delay_s", 0.0)) <= 0
+        or int(getattr(chaos_cfg, "kill_process", -1)) != int(worker_id)
+    ):
+        return 0.0
+    marker_dir = Path(marker_dir)
+    killed = marker_dir / f"chaos_killed_p{int(worker_id)}"
+    delayed = marker_dir / f"chaos_rejoin_delayed_p{int(worker_id)}"
+    if not killed.exists() or delayed.exists():
+        return 0.0
+    marker_dir.mkdir(parents=True, exist_ok=True)
+    delayed.write_text(str(chaos_cfg.rejoin_delay_s))
+    return float(chaos_cfg.rejoin_delay_s)
+
+
 def population_report(
     plan: "FaultPlan | None", round_idx: int, client_ids, attempt: int = 0
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -266,22 +266,37 @@ class ParticipationLedger:
             ),
         }
 
-    def load_state_dict(self, state: dict) -> None:
+    def load_state_dict(self, state: dict, resize: bool = False) -> None:
+        """Restore the ledger. ``resize=False`` (the default) demands an
+        exact population match — a mismatch on a fixed-world resume is a
+        config error. ``resize=True`` is the elastic-membership continuity
+        mode: a sidecar saved under a DIFFERENT population size is adopted
+        by copying the overlapping prefix of every counter (clients beyond
+        the saved population start their history fresh; counters for
+        clients that no longer exist are dropped) and keeping only the
+        quarantine entries still addressable — participation history
+        survives an epoch's slot rebalance instead of resetting to zero.
+        """
         pop = int(state["population"])
-        if pop != self.population:
+        if pop != self.population and not resize:
             raise ValueError(
                 f"ledger population mismatch: saved {pop} vs configured "
                 f"{self.population}"
             )
+        n = min(pop, self.population)
         for key in ("selected", "reported", "dropped", "deadline_cut"):
             arr = np.asarray(state[key], np.int64)
-            if arr.shape != (self.population,):
+            if arr.shape != (pop,):
                 raise ValueError(f"ledger {key} shape {arr.shape}")
-            setattr(self, key, arr.copy())
+            fresh = np.zeros((self.population,), np.int64)
+            fresh[:n] = arr[:n]
+            setattr(self, key, fresh)
         ids = np.asarray(state.get("quarantine_ids", ()), np.int64)
         until = np.asarray(state.get("quarantine_until", ()), np.int64)
         self.quarantined = {
-            int(c): int(u) for c, u in zip(ids.reshape(-1), until.reshape(-1))
+            int(c): int(u)
+            for c, u in zip(ids.reshape(-1), until.reshape(-1))
+            if int(c) < self.population
         }
 
 

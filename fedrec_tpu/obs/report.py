@@ -383,6 +383,29 @@ def build_report(
                     sh[key] = v
             report["sharding"] = sh
 
+        # ---- membership: the elastic epoch layer's view — this worker's
+        # epoch/world seat, the service-mirrored shrink/rejoin/lease-miss
+        # totals, its own reform departures and heartbeat failures, and
+        # the reshard cost of the last epoch hand-off. Keyed on the epoch
+        # gauge existing: a fixed-world run stays silent.
+        epoch = snapshot_value(last, "fed.membership_epoch")
+        if epoch is not None:
+            mem: dict[str, Any] = {"epoch": epoch}
+            for key, name in (
+                ("world", "fed.membership_world"),
+                ("shrinks", "fed.membership_shrinks"),
+                ("rejoins", "fed.membership_rejoins"),
+                ("lease_misses", "fed.membership_lease_misses"),
+                ("heartbeat_failures", "fed.lease_heartbeat_failures"),
+                ("reforms", "fed.membership_reforms_total"),
+                ("reshard_seconds", "shard.reshard_seconds"),
+                ("rows_recovered", "shard.reshard_rows_recovered_total"),
+            ):
+                v = snapshot_value(last, name)
+                if v is not None:
+                    mem[key] = v
+            report["membership"] = mem
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -583,6 +606,31 @@ def render_text(report: dict) -> str:
             )
             lines.append(
                 f"gather all_to_all: {_mib(shd['a2a_bytes'])}{remote}"
+            )
+        lines.append("")
+    mem = report.get("membership")
+    if mem:
+        lines.append("## Membership")
+        lines.append(
+            f"epoch: {int(mem['epoch'])}"
+            + (f", world: {int(mem['world'])}" if "world" in mem else "")
+        )
+        lines.append(
+            f"shrinks: {int(mem.get('shrinks', 0))}, "
+            f"rejoins: {int(mem.get('rejoins', 0))}, "
+            f"reform departures (this worker): {int(mem.get('reforms', 0))}"
+        )
+        lines.append(
+            f"lease misses: {int(mem.get('lease_misses', 0))}, "
+            f"heartbeat failures: {int(mem.get('heartbeat_failures', 0))}"
+        )
+        if "reshard_seconds" in mem:
+            rows = (
+                f", rows recovered: {int(mem['rows_recovered'])}"
+                if "rows_recovered" in mem else ""
+            )
+            lines.append(
+                f"last epoch hand-off: {mem['reshard_seconds']:.3f}s{rows}"
             )
         lines.append("")
     if "cap_overflow_steps" in report:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import atexit
 import os
+import random
 import threading
 import time
 from typing import Any, Callable
@@ -35,17 +36,103 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import multihost_utils
 
+# start_round's out-of-band control value: the server broadcasts it at a
+# round boundary when the elastic membership layer needs the world to
+# REFORM (a peer rejoined, or the server's lease watchdog flagged a loss).
+# Every worker receiving it saves a hand-off snapshot and leaves the world
+# so the next membership epoch can form; -1 keeps meaning "stop".
+REFORM_SIGNAL = -2
+
+
+def _attempt_address(addr: str | None, attempt: int) -> str | None:
+    """Rendezvous address for retry ``attempt``: the configured port plus
+    the attempt index. Every peer derives the SAME schedule, so after a
+    failed bring-up the whole world realigns on a fresh port — the broken
+    attempt's coordination service and gloo pairs are abandoned in place
+    (shutting them down is what fatally terminates the process, XLA
+    ``client.h:80``; see ``_abandon_broken_world``)."""
+    if addr is None or attempt == 0:
+        return addr
+    host, port = addr.rsplit(":", 1)
+    return f"{host}:{int(port) + attempt}"
+
+
+def _probe_transport(timeout_s: float) -> None:
+    """One bounded warm-up collective after rendezvous: the gloo TCP pairs
+    connect lazily at the FIRST collective, which is where the known
+    transport flake ("pair.cc: Connection closed by peer") surfaces — not
+    at ``jax.distributed.initialize``. Probing here turns that flake into
+    a retryable bring-up failure instead of a mid-training world break.
+    The peer whose pair broke sees the error; every other peer's probe
+    hangs and times out — so ALL peers fail the attempt and realign on
+    the next attempt's address."""
+    box: list = []
+    errs: list = []
+
+    def target():
+        try:
+            box.append(
+                multihost_utils.sync_global_devices("fedrec_transport_probe")
+            )
+        except Exception as exc:  # noqa: BLE001 — transport probe failure
+            errs.append(exc)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if errs:
+        raise RuntimeError(f"transport probe failed: {errs[0]!r}")
+    if t.is_alive():
+        raise RuntimeError(
+            f"transport probe timed out after {timeout_s}s (a peer's gloo "
+            "pair likely broke; retrying the rendezvous)"
+        )
+
+
+def _abandon_broken_world() -> None:
+    """Detach from a broken bring-up WITHOUT calling shutdown: the
+    shutdown barrier on a broken world is exactly the observed fatal path
+    (``client.h:80`` terminates the process when the disconnect RPC cannot
+    complete). The old client/service objects are leaked in place — their
+    heartbeats keep each other content on the abandoned port while the
+    retry rendezvouses on the next one — and the backend cache is cleared
+    so the next device use rebuilds gloo pairs against the new client."""
+    from jax._src import distributed as _dist
+
+    state = _dist.global_state
+    state.client = None
+    state.service = None
+    state.preemption_sync_manager = None
+    try:
+        from jax.extend import backend as _backend
+
+        _backend.clear_backends()
+    except Exception:  # noqa: BLE001 — backends may not exist yet
+        pass
+
 
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
     initialization_timeout: float | None = None,
+    rendezvous_retries: int = 2,
+    probe_timeout_s: float = 30.0,
 ) -> tuple[int, int]:
     """Join the multi-host world; returns (process_id, num_processes).
 
     All arguments default to cluster auto-detection (TPU pod metadata); set
     them explicitly for manual bring-up, e.g. CPU-based integration tests.
+
+    Bring-up is RETRIED (``rendezvous_retries`` extra attempts, jittered
+    backoff): on the CPU/gloo path each attempt ends with a bounded
+    warm-up collective (:func:`_probe_transport`) so the known gloo
+    transport flake — a TCP pair dying at the first collective, which
+    used to fail ``test_multihost_world`` and block the shard smoke's
+    2-process step leg — fails the ATTEMPT instead of the run. Attempt
+    *k* rendezvouses on ``port + k`` (every peer derives the same
+    schedule) because a broken attempt's coordination service cannot be
+    safely shut down or re-bound (see :func:`_abandon_broken_world`).
 
     ``jax_enable_recoverability`` is enabled: without it the coordination
     service propagates any task failure as fatal to every non-leader.
@@ -86,19 +173,55 @@ def initialize_distributed(
         # dying world must FAIL (and be retried by its supervisor) rather
         # than sit in jax's default 5-minute rendezvous wait
         kwargs["initialization_timeout"] = int(initialization_timeout)
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kwargs,
-        )
-    except TypeError:  # older jax without initialization_timeout
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    # the probe only makes sense for an explicit multi-process CPU/gloo
+    # bring-up: auto-detected TPU pods keep their native ICI transport
+    # (and their init-time behavior) untouched
+    probe = (
+        coordinator_address is not None
+        and (num_processes or 1) > 1
+        and first in ("cpu", "")
+    )
+    attempts = max(int(rendezvous_retries), 0) + 1 if probe else 1
+    rng = random.Random(os.getpid())
+    for attempt in range(attempts):
+        addr = _attempt_address(coordinator_address, attempt)
+        # an INITIALIZE failure raises immediately in every attempt: it is
+        # NOT collective (e.g. one respawn racing a dying world fails
+        # alone), so retrying it in-process would walk this peer down the
+        # port schedule while the others wait at the base port — that
+        # retry belongs to the supervisor. Only the PROBE below — a
+        # collective every peer fails together — advances the schedule.
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except TypeError:  # older jax without initialization_timeout
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        if not probe:
+            break
+        try:
+            _probe_transport(probe_timeout_s)
+            break
+        except RuntimeError as exc:
+            if attempt + 1 >= attempts:
+                raise
+            _abandon_broken_world()
+            delay = min(1.0 * (attempt + 1), 5.0) * (0.5 + rng.random())
+            print(
+                f"[multihost] transport probe attempt {attempt + 1}/"
+                f"{attempts} failed ({exc}); re-rendezvous on "
+                f"{_attempt_address(coordinator_address, attempt + 1)} "
+                f"in {delay:.1f}s",
+                flush=True,
+            )
+            time.sleep(delay)
     return jax.process_index(), jax.process_count()
 
 
@@ -398,9 +521,17 @@ class CoordinatorRuntime:
         round_deadline_s: float | None = None,
         topk_ratio: float = 0.01,
         error_feedback: bool = True,
+        membership: Any = None,
+        epoch: int = 0,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
+        # elastic membership (fedrec_tpu.parallel.membership): the client
+        # whose lease-renewal thread latches reform_pending, and this
+        # world's membership epoch. None = the fixed pre-elastic world —
+        # start_round then never emits REFORM_SIGNAL (degenerate contract).
+        self.membership = membership
+        self.epoch = int(epoch)
         self.collective_timeout_s = collective_timeout_s
         # cross-device round deadline (fed.population.round_deadline_ms):
         # bounds the round-end AGGREGATION gather specifically — a peer
@@ -486,11 +617,28 @@ class CoordinatorRuntime:
         return box[0]
 
     def start_round(self, round_idx: int, total_rounds: int) -> int:
-        """Negotiate the next round: returns the SERVER's round index, or -1
-        to stop. Clients must adopt the returned counter (their own may be
-        stale after a partial-snapshot resume). Locally (single process or
-        degraded) it is the caller's own counter that decides."""
+        """Negotiate the next round: returns the SERVER's round index, -1
+        to stop, or :data:`REFORM_SIGNAL` when the elastic membership
+        layer wants the world to reform at this boundary (a rejoining
+        peer, or the server's lease watchdog flagged a loss the
+        collectives have not hit yet). Clients must adopt the returned
+        counter (their own may be stale after a partial-snapshot resume).
+        Locally (single process or degraded) it is the caller's own
+        counter that decides.
+
+        The reform decision is the SERVER's and travels in the SAME
+        broadcast as the round counter — one collective, so every worker
+        leaves at the identical boundary instead of discovering the
+        reform at skewed heartbeat times and stranding each other's
+        collectives mid-round (the reformation barrier)."""
         local = round_idx if round_idx < total_rounds else -1
+        if (
+            self.membership is not None
+            and self.is_server
+            and local >= 0
+            and self.membership.reform_pending
+        ):
+            local = REFORM_SIGNAL
         if self.num_processes == 1:
             return local
         return self._collective(

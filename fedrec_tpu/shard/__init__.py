@@ -23,13 +23,17 @@ from fedrec_tpu.shard.policy import (
     fsdp_leaf_sharding,
     fsdp_shardings,
     fsdp_state_shardings,
+    reshard_state,
     shard_bytes_per_device,
 )
 from fedrec_tpu.shard.table import (
     ShardedNewsTable,
     TableSpec,
     a2a_bytes_per_gather,
+    lost_row_mask,
     owner_bucketed_gather,
+    recover_table_rows,
+    reshard_table,
 )
 
 __all__ = [
@@ -40,6 +44,10 @@ __all__ = [
     "fsdp_leaf_sharding",
     "fsdp_shardings",
     "fsdp_state_shardings",
+    "lost_row_mask",
     "owner_bucketed_gather",
+    "recover_table_rows",
+    "reshard_state",
+    "reshard_table",
     "shard_bytes_per_device",
 ]

@@ -122,6 +122,37 @@ def fsdp_state_shardings(state: Any, mesh: Mesh, cfg: Any) -> Any | None:
     )
 
 
+def reshard_state(state: Any, mesh: Mesh, cfg: Any) -> Any:
+    """Re-commit a HOST-complete state pytree (e.g. the last
+    ``gather_for_save`` checkpoint, or a multihost gather of the
+    survivors' shards) to a re-formed mesh's at-rest layout — the
+    FSDP half of shrink-and-continue.  The policy re-derives per-leaf
+    shardings for the NEW mesh (the fsdp axis size may have changed with
+    the world), so a state sharded 4-way re-commits 2-way without any
+    layout assumptions carried over; with ``shard.fsdp <= 1`` (or no fsdp
+    axis) it falls back to the classic leading-dim client sharding —
+    exactly the Trainer's ``_place_state`` rule, value-exact by
+    construction (host bytes in, host bytes out; only residency moves).
+
+    Production resumes go through the Trainer (``adopt_state`` →
+    ``_place_state``); this is the LIBRARY twin for host-side tooling
+    (and the unit pin that the contract holds across a world change,
+    ``tests/test_membership.py``) — keep the two rules in lockstep.
+    """
+    import jax.numpy as jnp
+    from fedrec_tpu.parallel.mesh import client_sharding
+
+    shardings = fsdp_state_shardings(state, mesh, cfg)
+    if shardings is None:
+        sharding = client_sharding(mesh, cfg.fed.mesh_axis)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding), state
+        )
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jnp.asarray(x), s), state, shardings
+    )
+
+
 def shard_bytes_per_device(state: Any, shardings: Any) -> int:
     """At-rest bytes ONE device holds under ``shardings`` — the number the
     ``shard.state_bytes_per_device`` gauge publishes, so an operator can

@@ -50,6 +50,9 @@ __all__ = [
     "ShardedNewsTable",
     "owner_bucketed_gather",
     "a2a_bytes_per_gather",
+    "lost_row_mask",
+    "recover_table_rows",
+    "reshard_table",
 ]
 
 
@@ -148,6 +151,75 @@ def owner_bucketed_gather(
     gathered_sorted = recv[sorted_owner, rank]
     inv = jnp.argsort(order, stable=True)
     return gathered_sorted[inv]
+
+
+def lost_row_mask(spec: TableSpec, lost_shards) -> np.ndarray:
+    """``(num_rows,)`` bool: True for the TRUE catalog rows whose owner
+    shard is in ``lost_shards`` — the rows a dead host/device took with it
+    under the ``[s*R, (s+1)*R)`` row-sharded layout.  Padding rows are
+    outside ``num_rows`` and never appear."""
+    owner = np.arange(spec.num_rows) // spec.rows_per_shard
+    return np.isin(owner, np.asarray(sorted(set(int(s) for s in lost_shards))))
+
+
+def recover_table_rows(
+    surviving_rows: Any,
+    lost_shards,
+    spec: TableSpec,
+    checkpoint_rows: Any,
+) -> tuple[np.ndarray, int]:
+    """Rebuild the full TRUE-row table after a shrink lost some shards.
+
+    ``surviving_rows`` is a host copy of the old ``(padded_rows, ...)``
+    sharded buffer in which the ``lost_shards`` blocks are gone (garbage,
+    zeros — whatever the dead owner left unreachable); ``checkpoint_rows``
+    is the last :func:`~fedrec_tpu.train.checkpoint.save_table_checkpoint`
+    table (unpadded ``(num_rows, ...)``).  Lost rows are refilled from the
+    checkpoint, surviving rows are kept LIVE (bit-identical to what the
+    survivors held), and the result is the exact ``(num_rows, ...)`` table
+    ready for :meth:`ShardedNewsTable.create` on the new, smaller mesh.
+    Returns ``(full_rows, rows_recovered)``.
+
+    Raises when a lost row has no checkpoint to come back from — losing
+    catalog rows silently is the pre-elastic failure this replaces.
+
+    Call-site note: the COORDINATOR deployment's elastic recovery reloads
+    the whole table (each host builds its local sharded table from the
+    full token source / ``load_table_checkpoint``), so this partial-rows
+    path serves the single-process multi-device loss case and pins the
+    no-rows-lost acceptance contract (``tests/test_membership.py``).
+    """
+    surviving = np.asarray(surviving_rows)[: spec.num_rows]
+    mask = lost_row_mask(spec, lost_shards)
+    if not mask.any():
+        return surviving.copy(), 0
+    if checkpoint_rows is None:
+        raise ValueError(
+            f"{int(mask.sum())} catalog rows lived on lost shard(s) "
+            f"{sorted(set(int(s) for s in lost_shards))} and no table "
+            "checkpoint exists to recover them from — save one with "
+            "train.checkpoint.save_table_checkpoint (the Trainer does at "
+            "save cadence under shard.table) or re-supply the token source"
+        )
+    ckpt = np.asarray(checkpoint_rows)
+    if ckpt.shape[0] < spec.num_rows:
+        raise ValueError(
+            f"table checkpoint holds {ckpt.shape[0]} rows but the catalog "
+            f"has {spec.num_rows}; it cannot recover the lost shards"
+        )
+    full = surviving.copy()
+    full[mask] = ckpt[: spec.num_rows][mask]
+    return full, int(mask.sum())
+
+
+def reshard_table(
+    full_rows: Any, mesh: Mesh, axis: str, dtype: Any = None
+) -> ShardedNewsTable:
+    """Commit a recovered full-row table to a (re-formed) mesh — the
+    shrink-and-continue tail of :func:`recover_table_rows`.  Identical to
+    :meth:`ShardedNewsTable.create` (padding recomputed for the NEW shard
+    count), named separately so reshard call sites read as what they are."""
+    return ShardedNewsTable.create(full_rows, mesh, axis, dtype=dtype)
 
 
 def a2a_bytes_per_gather(

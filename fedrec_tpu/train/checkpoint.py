@@ -232,6 +232,44 @@ def atomic_write_bytes(path: Path, blob: bytes) -> None:
     os.replace(tmp, path)
 
 
+NEWS_TABLE_CHECKPOINT = "news_table.npy"
+
+
+def save_table_checkpoint(directory: str | Path, rows: Any) -> Path:
+    """Persist the full (host-gathered, unpadded) news/token table next to
+    the snapshots — the recovery source for a sharded-catalog shrink: a
+    lost host takes its ``shard.table`` row blocks with it, and the
+    re-formed world reloads those rows from HERE instead of losing them
+    (``shard.table.recover_table_rows``).  Atomic, like every snapshot
+    artifact.  The table is frozen in table/head modes, so one write per
+    run suffices (callers skip the write when the file exists)."""
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(rows))
+    path = Path(directory) / NEWS_TABLE_CHECKPOINT
+    path.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_bytes(path, buf.getvalue())
+    return path
+
+
+def load_table_checkpoint(directory: str | Path) -> np.ndarray | None:
+    """Inverse of :func:`save_table_checkpoint`; ``None`` when absent or
+    unreadable (recovery then falls back to the original token source —
+    a torn table checkpoint must not kill a resume)."""
+    path = Path(directory) / NEWS_TABLE_CHECKPOINT
+    if not path.exists():
+        return None
+    try:
+        return np.load(path)
+    except (OSError, ValueError) as e:
+        print(
+            f"[checkpoint] table checkpoint {path.name} unreadable "
+            f"({type(e).__name__}: {e}); ignoring it"
+        )
+        return None
+
+
 POPULATION_SIDECAR = "population_state.msgpack"
 
 

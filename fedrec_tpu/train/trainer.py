@@ -1058,6 +1058,69 @@ class Trainer:
         self.state = self._place_state(state)
         self._table = None  # params changed; a cached decoupled table is stale
 
+    def population_sidecar_bytes(self, round_idx: int) -> bytes | None:
+        """The cohort engine's schedule-defining state (sampler fairness
+        counters + participation ledger + slot occupancy), serialized for
+        persistence — or ``None`` when no population engine is active.
+        The orbax path writes ``population_state.msgpack`` itself
+        (:meth:`_after_round`); the coordinator deployment persists this
+        per WORKER next to its local msgpack snapshot so an elastic
+        epoch change can carry participation history across the
+        re-formed world."""
+        if not self._pop_engine:
+            return None
+        from fedrec_tpu.train.checkpoint import population_state_bytes
+
+        return population_state_bytes(
+            self.cohort_sampler.state_dict(),
+            self.population.ledger.state_dict(),
+            self._slot_occupants,
+            self._slot_writeback,
+            round_idx,
+        )
+
+    def adopt_population_sidecar(self, blob: bytes, resize: bool = False) -> int:
+        """Restore a population sidecar; returns its round tag.
+
+        ``resize=False`` demands exact population/slot agreement (the
+        fixed-world resume). ``resize=True`` is elastic-membership
+        continuity: the LEDGER adopts with prefix-copy resize semantics
+        (:meth:`ParticipationLedger.load_state_dict`), while sampler
+        fairness state and slot occupancy are adopted only when their
+        shapes still match — an epoch's re-deal otherwise restarts them
+        fresh (documented divergence: the cohort *schedule* re-anchors at
+        the new world, the participation *history* does not reset)."""
+        if not self._pop_engine:
+            raise ValueError(
+                "adopt_population_sidecar needs an active fed.population "
+                "engine (fed.population.num_clients > 0)"
+            )
+        from fedrec_tpu.train.checkpoint import load_population_state
+
+        pst = load_population_state(blob)
+        try:
+            self.cohort_sampler.load_state_dict(pst["sampler"])
+        except ValueError:
+            if not resize:
+                raise
+            print(
+                "[trainer] population sampler state does not fit the "
+                "re-formed world; fairness counters restart fresh "
+                "(ledger continuity is preserved)"
+            )
+        self.population.ledger.load_state_dict(pst["ledger"], resize=resize)
+        occ = np.asarray(pst["slot_occupants"], np.int64)
+        wb = np.asarray(pst["slot_writeback"], bool)
+        if occ.shape == self._slot_occupants.shape:
+            self._slot_occupants = occ.copy()
+            self._slot_writeback = wb.copy()
+        elif not resize:
+            raise ValueError(
+                f"population sidecar slot count {occ.shape} does not match "
+                f"the configured {self._slot_occupants.shape} slots"
+            )
+        return int(pst["round"])
+
     def set_global_params(self, user_params: Any, news_params: Any) -> None:
         """Adopt externally-aggregated parameters on every local client.
 
@@ -2710,6 +2773,26 @@ class Trainer:
                             round_idx,
                         ),
                     )
+                if self.table_spec is not None and self.token_states is not None:
+                    # sharded-catalog recovery source: the TRUE rows,
+                    # host-gathered, written ONCE (the table is frozen in
+                    # table/head modes) — a shrink that loses a shard's
+                    # row blocks reloads them from here instead of losing
+                    # them (shard.table.recover_table_rows)
+                    from fedrec_tpu.train.checkpoint import (
+                        NEWS_TABLE_CHECKPOINT,
+                        gather_for_save,
+                        save_table_checkpoint,
+                    )
+
+                    tbl_path = (
+                        self.snapshots.directory / NEWS_TABLE_CHECKPOINT
+                    )
+                    if not tbl_path.exists():
+                        rows = np.asarray(
+                            gather_for_save(self.token_states)
+                        )[: self.table_spec.num_rows]
+                        save_table_checkpoint(self.snapshots.directory, rows)
         if (
             self._obs_dir is not None
             and (round_idx + 1) % max(cfg.obs.snapshot_every, 1) == 0

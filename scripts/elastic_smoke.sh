@@ -1,0 +1,164 @@
+#!/bin/bash
+# Elastic-federation smoke (ISSUE-12 acceptance), CPU-only:
+#
+#   A 4-process gloo world under elastic membership
+#   (fedrec_tpu.parallel.membership) loses one peer to a chaos kill
+#   mid-run and must
+#
+#     1. SHRINK-AND-CONTINUE: the survivors re-form as membership epoch 1
+#        at world 3 and keep federating (NOT 4 standalone forks — the
+#        pre-elastic failure mode);
+#     2. REJOIN: the killed peer's supervisor respawns it (held off by
+#        chaos.rejoin_delay_s so the shrink is observable first); its
+#        join knocks on the healthy epoch, the server broadcasts the
+#        reformation at a round boundary, and epoch 2 re-forms at
+#        world 4;
+#     3. FINISH: the full-complement world completes every round and the
+#        final evaluation runs;
+#     4. ACCOUNT: the membership service's counters match the script —
+#        exactly one shrink, exactly one rejoin, epoch history
+#        world 4 -> 3 -> 4.
+#
+#   scripts/elastic_smoke.sh     # or: make elastic-smoke
+#
+# Artifacts land under /tmp/fedrec_elastic_smoke for inspection.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${ELASTIC_SMOKE_DIR:-/tmp/fedrec_elastic_smoke}
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+MPORT=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
+
+ROUNDS=10
+
+# ------------------------------------------------ the membership service
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.parallel.membership "127.0.0.1:$MPORT" \
+    --target-world 4 \
+    > "$OUT/membership.log" 2>&1 &
+MEM_PID=$!
+cleanup() { kill "$MEM_PID" 2>/dev/null || true; }
+trap cleanup EXIT
+sleep 1
+
+# --------------------------------------------------- 4 supervised workers
+run_worker() {
+    env -u PALLAS_AXON_POOL_IPS -u XLA_FLAGS JAX_PLATFORMS=cpu \
+        FEDREC_SUPERVISE_MAX=12 \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m fedrec_tpu.cli.coordinator "$ROUNDS" 8 1 \
+        --supervise \
+        --membership "127.0.0.1:$MPORT" \
+        --num-processes 4 --process-id "$1" \
+        --synthetic --synthetic-train 960 --synthetic-news 64 \
+        --clients 1 --server-trains \
+        --collective-timeout 15 \
+        --set model.bert_hidden=48 --set data.max_his_len=10 \
+        --set data.max_title_len=12 --set model.news_dim=32 \
+        --set model.num_heads=4 --set model.head_dim=8 \
+        --set model.query_dim=16 \
+        --set "train.snapshot_dir=$OUT/d$1" \
+        --set "train.eval_every=$ROUNDS" \
+        --set fed.weight_by_samples=true \
+        --set optim.user_lr=0.001 --set optim.news_lr=0.001 \
+        --set chaos.enabled=true \
+        --set chaos.kill_round=2 --set chaos.kill_process=2 \
+        --set chaos.rejoin_delay_s=15 \
+        --set fed.elastic.lease_ms=5000 \
+        --set fed.elastic.heartbeat_ms=1000 \
+        --set fed.elastic.formation_grace_ms=6000 \
+        > "$OUT/worker_$1.log" 2>&1
+}
+
+PIDS=()
+for pid in 0 1 2 3; do
+    run_worker "$pid" & PIDS+=($!)
+done
+FAIL=0
+for i in 0 1 2 3; do
+    wait "${PIDS[$i]}" || { echo "[elastic-smoke] worker $i FAILED"; FAIL=1; }
+done
+if [ "$FAIL" -ne 0 ]; then
+    echo "[elastic-smoke] worker logs:"
+    tail -n 40 "$OUT"/worker_*.log
+    exit 1
+fi
+
+# --------------------------------------------------------- the assertions
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" MPORT="$MPORT" ROUNDS="$ROUNDS" \
+    python - <<'PY'
+import json
+import os
+from pathlib import Path
+
+from fedrec_tpu.parallel.membership import MembershipClient
+
+out = Path(os.environ["OUT"])
+rounds = int(os.environ["ROUNDS"])
+st = MembershipClient(
+    f"127.0.0.1:{os.environ['MPORT']}", worker_id="_smoke"
+).status()
+print("[elastic-smoke] membership status:", json.dumps(st))
+hist = [h["world"] for h in st["epoch_history"]]
+
+# 1. the initial epoch formed at the full complement
+assert hist and hist[0] == 4, hist
+# 2. shrink-and-continue: exactly one shrink, to world 3
+assert st["shrinks"] == 1, st
+assert 3 in hist, hist
+# 3. rejoin: exactly one, and the world grew back to 4
+assert st["rejoins"] == 1, st
+assert hist[-1] == 4, hist
+assert hist == [4, 3, 4], hist
+# the dead peer's lease expired exactly once
+assert st["lease_misses"] >= 1, st
+
+w2 = (out / "worker_2.log").read_text()
+assert "dying at round 2" in w2, "the chaos kill never fired"
+assert w2.count("dying at round 2") == 1, "marker guard failed"
+assert "holding off its rejoin" in w2, "chaos.rejoin_delay_s never applied"
+
+# shrink-and-continue really federated (epoch 1 ran at world 3): some
+# worker joined a rank/3 seat
+joined3 = any(
+    "/3 (coordinator" in (out / f"worker_{i}.log").read_text()
+    for i in range(4)
+)
+assert joined3, "no worker ever joined a world-3 epoch"
+
+# the reformation barrier fired (workers left for reform, not crash)
+reforms = sum(
+    (out / f"worker_{i}.log").read_text().count("for reformation")
+    for i in range(4)
+)
+assert reforms >= 3, f"expected a world-wide reformation, saw {reforms}"
+
+# 4. the run FINISHED at the full world: the server trained the final
+# round and the final evaluation ran
+w0 = (out / "worker_0.log").read_text()
+final_rounds = set()
+evaled = False
+for line in w0.splitlines():
+    if '"training_loss"' in line:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        final_rounds.add(int(rec["round"]))
+        if rec.get("auc") is not None or rec.get("valid_auc") is not None:
+            evaled = True
+assert (rounds - 1) in final_rounds, sorted(final_rounds)
+assert evaled, "the final evaluation never ran"
+print("[elastic-smoke] counters + logs match the script")
+PY
+
+echo "[elastic-smoke] OK"

@@ -1,20 +1,21 @@
 #!/bin/bash
 # Sharding smoke (ISSUE-11 acceptance scenarios), CPU-only:
 #
-#   1. 2-PROCESS GLOO EXCHANGE: a REAL two-process world
+#   1. 2-PROCESS GLOO EXCHANGE + FULL STEP: a REAL two-process world
 #      (jax.distributed + gloo CPU collectives, the coordinator
 #      deployment's rendezvous), 2 x 4 fake devices = one global
 #      8-device mesh, with the token-state table row-sharded across
 #      BOTH processes' devices — rows/device == padded/8 asserted from
-#      the addressable shards — and the owner-bucketed all_to_all
-#      gather crossing the process boundary over real gloo TCP. Must
-#      survive and return rows BIT-IDENTICAL to `full_table[ids]`.
-#      (The FULL train step in a 2-process gloo world is blocked on a
-#      pre-existing gloo transport flake on this rig — the slow-marked
-#      tests/test_multihost_world.py fails at HEAD with the same
-#      pair.cc error before any sharding code existed — so the step
-#      legs below run on the single-process 8-device mesh, where every
-#      collective of the step is exercised reliably.)
+#      the addressable shards — the owner-bucketed all_to_all gather
+#      crossing the process boundary over real gloo TCP (rows
+#      BIT-IDENTICAL to `full_table[ids]`), and the FULL federated
+#      train step through the sharded catalog, with both processes'
+#      results asserted bit-equal. (The full-step leg was previously
+#      blocked on a gloo transport flake — a TCP pair dying at the
+#      first collective, the same pair.cc error that failed
+#      tests/test_multihost_world.py at HEAD; the bounded
+#      rendezvous-retry + transport probe in initialize_distributed
+#      now turns that flake into a retried bring-up.)
 #   2. SHARDED-TABLE STEP EQUALITY: the federated train step through
 #      the sharded catalog on the 8-device mesh must be BIT-IDENTICAL
 #      to the replicated-table step (the degenerate-config equality),
@@ -34,11 +35,12 @@ OUT=${SHARD_SMOKE_DIR:-/tmp/fedrec_shard_smoke}
 rm -rf "$OUT"
 mkdir -p "$OUT"
 
-PORT=$(python - <<'PY'
+free_port() {
+    python - <<'PY'
 import socket
 s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
 PY
-)
+}
 
 # ---------------------------------------------- leg 1: 2-process gloo world
 cat > "$OUT/gloo_worker.py" <<'PYEOF'
@@ -97,26 +99,139 @@ print(
     f"ids/client={U}",
     flush=True,
 )
+
+# ---- full-step leg: the federated train step THROUGH the sharded
+# catalog across the 2-process world (identical deterministic setup on
+# both processes; each process_put slices out its addressable shards)
+from pathlib import Path
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.fed import get_strategy
+from fedrec_tpu.models import NewsRecommender
+from fedrec_tpu.train import build_fed_train_step
+from fedrec_tpu.train.state import init_client_state, replicate_state
+
+outdir = Path(sys.argv[3])
+cfg = ExperimentConfig()
+cfg.model.news_dim = 32
+cfg.model.num_heads = 4
+cfg.model.head_dim = 8
+cfg.model.query_dim = 16
+cfg.model.bert_hidden = D
+cfg.model.text_encoder_mode = "head"
+cfg.model.dropout_rate = 0.0
+cfg.data.max_his_len = 10
+cfg.data.max_title_len = L
+cfg.data.batch_size = 8
+cfg.fed.num_clients = 8
+cfg.shard.table = True
+
+model = NewsRecommender(cfg.model)
+st = replicate_state(
+    init_client_state(model, cfg, jax.random.PRNGKey(0), N, L),
+    8, jax.random.PRNGKey(1),
+)
+
+
+def to_global(x, spec=P("clients")):
+    # make_array_from_callback builds each process's addressable shards
+    # LOCALLY from the (identical, same-seed) host value — zero
+    # collectives. device_put against a multi-host sharding would issue
+    # a cross-process value-check broadcast PER LEAF, and concurrent
+    # small broadcasts are exactly where this rig's gloo transport
+    # desyncs (pair.cc preamble mismatches).
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, NamedSharding(mesh, spec), lambda idx: x[idx]
+    )
+
+
+st = jax.tree_util.tree_map(to_global, st)
+rng2 = np.random.default_rng(7)
+b = cfg.data.batch_size
+batch = {
+    "candidates": rng2.integers(
+        0, N, (8, b, 1 + cfg.data.npratio)
+    ).astype(np.int32),
+    "history": rng2.integers(
+        0, N, (8, b, cfg.data.max_his_len)
+    ).astype(np.int32),
+    "labels": np.zeros((8, b), np.int32),
+}
+batch = {k: to_global(v) for k, v in batch.items()}
+step = build_fed_train_step(
+    model, cfg, get_strategy("param_avg"), mesh, mode="joint",
+    sharded_table=tab.spec,
+)
+out_state, metrics = step(st, batch, tab.rows)
+rep_step = jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))(
+    (out_state.user_params, out_state.news_params, metrics["loss"])
+)
+flat_u = np.concatenate(
+    [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(rep_step[0])]
+)
+flat_n = np.concatenate(
+    [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(rep_step[1])]
+)
+loss = np.asarray(rep_step[2])
+assert np.isfinite(loss).all(), loss
+np.savez(outdir / f"step_{pid}.npz", user=flat_u, news=flat_n, loss=loss)
+print(f"GLOO_STEP_OK {pid} loss_mean={float(loss.mean()):.5f}", flush=True)
 PYEOF
 
 run_worker() {
     env -u PALLAS_AXON_POOL_IPS -u XLA_FLAGS JAX_PLATFORMS=cpu \
         PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
-        python "$OUT/gloo_worker.py" "$PORT" "$1" \
+        python "$OUT/gloo_worker.py" "$2" "$1" "$OUT" \
         > "$OUT/gloo_worker_$1.log" 2>&1
 }
 
-run_worker 0 & P0=$!
-run_worker 1 & P1=$!
-FAIL=0
-wait "$P0" || FAIL=1
-wait "$P1" || FAIL=1
-if [ "$FAIL" -ne 0 ]; then
+# Bounded whole-world retry: the rig's gloo transport can drop a TCP
+# pair MID-RUN (pair.cc read/framing errors), which no in-process retry
+# can recover — the coordination runtime is poisoned. Bring-up flakes
+# are already retried inside initialize_distributed (transport probe +
+# port schedule); a mid-run pair death relaunches BOTH workers on a
+# fresh port. Only the gloo transport signature retries — any other
+# failure is a real regression and fails immediately.
+LEG_OK=0
+for ATTEMPT in 1 2 3; do
+    PORT=$(free_port)
+    rm -f "$OUT"/step_*.npz
+    run_worker 0 "$PORT" & P0=$!
+    run_worker 1 "$PORT" & P1=$!
+    FAIL=0
+    wait "$P0" || FAIL=1
+    wait "$P1" || FAIL=1
+    if [ "$FAIL" -eq 0 ]; then
+        LEG_OK=1
+        break
+    fi
+    if [ "$ATTEMPT" -lt 3 ] \
+        && grep -qE "pair\.cc|[Gg]loo" "$OUT"/gloo_worker_*.log; then
+        echo "[shard-smoke] gloo transport flake (attempt $ATTEMPT);" \
+             "relaunching the 2-process world on a fresh port"
+        continue
+    fi
+    break
+done
+if [ "$LEG_OK" -ne 1 ]; then
     echo "[shard-smoke] 2-process gloo leg FAILED — worker logs:"
     cat "$OUT"/gloo_worker_*.log
     exit 1
 fi
 grep -h "GLOO_GATHER_OK" "$OUT"/gloo_worker_*.log
+grep -h "GLOO_STEP_OK" "$OUT"/gloo_worker_*.log
+
+# the 2-process step leg's results are bit-equal across processes
+python - <<PYEOF
+import numpy as np
+a = np.load("$OUT/step_0.npz")
+b = np.load("$OUT/step_1.npz")
+np.testing.assert_array_equal(a["user"], b["user"])
+np.testing.assert_array_equal(a["news"], b["news"])
+np.testing.assert_array_equal(a["loss"], b["loss"])
+print("[shard-smoke] 2-process full-step bit-equality OK")
+PYEOF
 
 # ------------------------------- legs 2+3: step equality on the 8-dev mesh
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

@@ -181,29 +181,53 @@ def test_two_process_global_mesh_matches_single_process(tmp_path):
     )
     gold_loss = float(np.mean(np.asarray(m["mean_loss"])))
 
-    port = _free_port()
     script = tmp_path / "world_worker.py"
     script.write_text(WORLD_WORKER)
     env = cpu_host_env()
     env.pop("XLA_FLAGS", None)  # the worker sets its own 4-device flag
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(port), str(pid), str(tmp_path)],
-            env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+
+    def launch_world(port: int):
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(port), str(pid),
+                 str(tmp_path)],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for pid in (0, 1)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(stdout)
+        return procs, outs
+
+    # Bounded whole-world retry for the rig's known gloo transport flake:
+    # a TCP pair can die MID-RUN (pair.cc read/framing errors), which
+    # poisons the coordination runtime beyond any in-process recovery —
+    # bring-up flakes are already retried inside initialize_distributed
+    # (transport probe + port schedule). Only the gloo signature retries;
+    # any other failure is a real regression and fails on attempt 1.
+    for attempt in range(3):
+        procs, outs = launch_world(_free_port())
+        if all(p.returncode == 0 for p in procs):
+            break
+        gloo_flake = any(
+            p.returncode != 0 and ("pair.cc" in out or "gloo" in out.lower())
+            for p, out in zip(procs, outs)
         )
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(stdout)
+        if not gloo_flake or attempt == 2:
+            break
+        print(
+            f"[test_multihost_world] gloo transport flake (attempt "
+            f"{attempt + 1}); relaunching the world on a fresh port"
+        )
     for pid, (p, stdout) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{stdout[-4000:]}"
         assert f"WORLD_OK {pid}" in stdout, stdout[-4000:]
