@@ -314,13 +314,7 @@ def main(argv: list[str] | None = None) -> int:
         # lease_ms — a late first renewal would read as a death and
         # reform the world that just formed
         membership.start_heartbeat()
-        try:
-            publish_membership_metrics(
-                assignment=assignment, client=membership,
-                status=membership.status(),
-            )
-        except (OSError, MembershipError):
-            publish_membership_metrics(assignment=assignment, client=membership)
+        publish_membership_metrics(assignment=assignment, client=membership)
 
     coordinator_address = args.coordinator
     world_processes = args.num_processes
@@ -488,6 +482,40 @@ def main(argv: list[str] | None = None) -> int:
             n_local = -(-(n_local - cfg.data.shard_index) // cfg.data.num_shards)
         cfg.privacy.sigma = calibrate_from_config(cfg, n_local)
 
+    # ---- fleet observability (fedrec_tpu.obs.fleet): stamp this
+    # worker's stable id + per-epoch rank/epoch into every span,
+    # snapshot and JSONL record; give each worker its OWN obs subdir
+    # (the worker_* layout `fedrec-obs fleet` merges); and re-seed the
+    # registry's counters from the persisted baseline so a respawned
+    # worker's totals resume instead of resetting
+    from fedrec_tpu.obs.fleet import (
+        restore_counter_baseline,
+        set_fleet_identity,
+    )
+
+    # snapshot/artifact identity: under elastic membership the STABLE
+    # worker id (ranks are re-dealt every epoch, so rank-keyed files
+    # would adopt a different worker's state after a reshuffle); the
+    # rank otherwise — THE one definition, shared by the obs worker dir,
+    # the state_suffix snapshot naming and the chaos-kill target below
+    ident = int(args.process_id) if membership is not None else rt.process_id
+    set_fleet_identity(
+        worker=str(ident),
+        rank=rt.process_id,
+        epoch=assignment.epoch if assignment is not None else None,
+    )
+    if cfg.obs.dir and (rt.num_processes > 1 or membership is not None):
+        cfg.obs.dir = str(Path(cfg.obs.dir) / f"worker_{ident}")
+    if cfg.obs.dir and membership is not None:
+        restore_counter_baseline(Path(cfg.obs.dir))
+    if assignment is not None:
+        from fedrec_tpu.obs import get_tracer
+
+        get_tracer().instant(
+            "membership_join", epoch=assignment.epoch,
+            rank=assignment.rank, world=assignment.world,
+        )
+
     trains = args.server_trains or not rt.is_server or rt.num_processes == 1
     local_snap = None
     # a degraded-mode respawn is a standalone process that must keep the
@@ -497,11 +525,8 @@ def main(argv: list[str] | None = None) -> int:
         rt.num_processes > 1 or args.resume_local_state
         or membership is not None
     )
-    # snapshot identity: under elastic membership the STABLE worker id
-    # (ranks are re-dealt every epoch, so rank-keyed files would adopt a
-    # different worker's state after a reshuffle); the rank otherwise —
-    # the unchanged pre-elastic naming
-    ident = int(args.process_id) if membership is not None else rt.process_id
+    # state files key on the same stable identity (`ident`, defined with
+    # the fleet-observability block above)
     state_suffix = (
         f"w{args.process_id}" if membership is not None
         else f"p{rt.process_id}"
@@ -701,6 +726,13 @@ def main(argv: list[str] | None = None) -> int:
                 f"(rc {RESPAWN_EXIT})",
                 flush=True,
             )
+            # obs flush is DEVICE-FREE (registry/tracer are host JSON),
+            # so it is safe on the degraded path — without it, every
+            # span this incarnation recorded before the world broke
+            # would vanish from the fleet merge
+            if trainer.fleet_pusher is not None:
+                trainer.fleet_pusher.push(final=True)
+            _dump_obs_artifacts()
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(RESPAWN_EXIT)
@@ -738,17 +770,26 @@ def main(argv: list[str] | None = None) -> int:
         os.execv(sys.executable, cmd)
 
     def _dump_obs_artifacts() -> None:
-        """Flush the registry/trace into ``obs.dir`` on the coordinator
-        CLI's exit paths (reform + finish): unlike Trainer.run, this loop
-        never writes registry snapshots itself, so without a final dump
-        the membership/reshard gauges would never reach the artifacts
-        `fedrec-obs report` reads."""
+        """Flush the registry/trace into this worker's obs dir on the
+        coordinator CLI's exit paths (reform + finish): unlike
+        Trainer.run, this loop never writes registry snapshots itself,
+        so without a final dump the membership/reshard gauges would
+        never reach the artifacts `fedrec-obs report` reads.  Elastic
+        workers tag the trace with their membership epoch
+        (``trace_e<N>.json``) so each incarnation's spans survive the
+        respawn that overwrites ``trace.json``, and persist the counter
+        baseline the next incarnation resumes from."""
         if not cfg.obs.dir:
             return
-        from fedrec_tpu.obs import dump_artifacts
+        from fedrec_tpu.obs import dump_artifacts, save_counter_baseline
 
         try:
-            dump_artifacts(Path(cfg.obs.dir) / f"worker_{ident}")
+            dump_artifacts(
+                Path(cfg.obs.dir),
+                trace_tag=f"e{rt.epoch}" if membership is not None else None,
+            )
+            if membership is not None:
+                save_counter_baseline(Path(cfg.obs.dir), epoch=rt.epoch)
         except OSError as e:
             print(f"[coordinator] obs artifact dump failed: {e}")
 
@@ -775,6 +816,17 @@ def main(argv: list[str] | None = None) -> int:
             snapshot_dir / NEWS_TABLE_CHECKPOINT
         ).exists():
             save_table_checkpoint(snapshot_dir, token_states)
+        if cfg.obs.dir:
+            # counter-baseline continuity rides the save cadence too: a
+            # worker killed BETWEEN reformations (the chaos-kill path,
+            # which never reaches a clean dump) still resumes its totals
+            # from the last cadence save
+            from fedrec_tpu.obs.fleet import save_counter_baseline
+
+            try:
+                save_counter_baseline(Path(cfg.obs.dir), epoch=rt.epoch)
+            except OSError:
+                pass
 
     def reform_handoff(next_round: int) -> None:
         """The reformation barrier's worker half: every member received
@@ -788,6 +840,9 @@ def main(argv: list[str] | None = None) -> int:
             f"[membership] worker {args.process_id} leaving epoch "
             f"{rt.epoch} at round boundary {next_round} for reformation",
             flush=True,
+        )
+        trainer.tracer.instant(
+            "membership_reform", epoch=rt.epoch, round=next_round
         )
         if local_snap is not None:
             from flax import serialization
@@ -814,12 +869,9 @@ def main(argv: list[str] | None = None) -> int:
             save_elastic_sidecars(next_round - 1)
         from fedrec_tpu.parallel.membership import publish_membership_metrics
 
-        try:
-            publish_membership_metrics(
-                reforms=1, client=membership, status=membership.status()
-            )
-        except Exception:  # noqa: BLE001 — a mute service can't block reform
-            publish_membership_metrics(reforms=1)
+        publish_membership_metrics(reforms=1, client=membership)
+        if trainer.fleet_pusher is not None:
+            trainer.fleet_pusher.push(final=True)
         _dump_obs_artifacts()
         trainer.logger.finish()
         # the world is HEALTHY here (the reform broadcast just completed),
@@ -912,6 +964,11 @@ def main(argv: list[str] | None = None) -> int:
             u, n = server_optimizer.step(round_start_global, (u, n))
         trainer.set_global_params(u, n)
 
+        # the coordinator loop completes rounds OUTSIDE Trainer.run, so
+        # the rounds counter advances here — Trainer._after_round (its
+        # only other inc site) never runs in this deployment, which left
+        # coordinator workers' round totals frozen at zero
+        trainer.registry.counter("train.rounds_total").inc()
         if result is not None:
             log = {"round": round_idx, "training_loss": result.train_loss}
             log.update(result.val_metrics)
@@ -991,6 +1048,11 @@ def main(argv: list[str] | None = None) -> int:
                     # (server.py:27)
                     for old in coordinator_globals(snapshot_dir)[:-3]:
                         old.unlink(missing_ok=True)
+        if trainer.fleet_pusher is not None:
+            # the coordinator loop drives rounds itself (Trainer._after_round
+            # never runs here), so the round-cadence telemetry push lands at
+            # this boundary instead
+            trainer.fleet_pusher.maybe_push(round_idx)
         round_idx += 1
 
     print(f"[coordinator] process {rt.process_id} done after {round_idx} rounds")
@@ -1001,15 +1063,12 @@ def main(argv: list[str] | None = None) -> int:
         # service's final status must read completion, not death
         from fedrec_tpu.parallel.membership import publish_membership_metrics
 
-        try:
-            publish_membership_metrics(
-                status=membership.status(), client=membership
-            )
-        except Exception:  # noqa: BLE001 — metrics must not block the exit
-            pass
+        publish_membership_metrics(client=membership)
         membership.leave()
         membership.close()
-        _dump_obs_artifacts()
+    if trainer.fleet_pusher is not None:
+        trainer.fleet_pusher.push(final=True)
+    _dump_obs_artifacts()
     trainer.logger.finish()  # before finalize: os._exit skips teardown
     rt.finalize(0)  # no-op unless the world broke mid-run (then exits here)
     return 0

@@ -31,9 +31,24 @@ Subcommands:
       bisecting to) the step that went non-finite.  Exit 0 when the
       dump's trigger is reproduced, 1 when it is not.
 
-``report``/``prom`` import no JAX — usable on any box the artifacts were
-copied to; ``replay`` imports JAX lazily (and pins ``JAX_PLATFORMS=cpu``
-unless the environment already chose a platform).
+  fedrec-obs fleet <dir> [--json]
+      Fleet-wide report over a directory of ``worker_*`` obs dirs (the
+      shared ``obs.dir`` of an elastic/coordinator run, or a collector's
+      ``--telemetry-dir``): per-worker identity/epoch/rounds, the
+      membership timeline, per-round straggler/critical-path attribution
+      (which worker gated each round's barrier, and in which phase), and
+      per-worker DCN bytes.  A single obs dir degrades to one worker.
+
+  fedrec-obs fleet-trace <dir> [-o merged.json]
+      ONE merged Chrome/Perfetto trace over every worker: a track per
+      worker, clocks aligned via the shared round barrier (each
+      ``fed_round`` N is a common event), membership epoch changes /
+      lease expiries / joins / quarantines rendered as instants.
+
+``report``/``prom``/``fleet``/``fleet-trace`` import no JAX — usable on
+any box the artifacts were copied to; ``replay`` imports JAX lazily (and
+pins ``JAX_PLATFORMS=cpu`` unless the environment already chose a
+platform).
 """
 
 from __future__ import annotations
@@ -126,6 +141,51 @@ def _cmd_prom(args) -> int:
     # the SAME renderer the live {"cmd": "prometheus"} endpoint uses —
     # offline output cannot drift from the wire exposition
     print(snapshot_to_prometheus(snapshots[-1]), end="")
+    return 0
+
+
+# ------------------------------------------------------------------- fleet
+def _load_fleet(path_arg: str):
+    from fedrec_tpu.obs.fleet import load_fleet_dir
+
+    try:
+        return load_fleet_dir(path_arg)
+    except FileNotFoundError as e:
+        return _fail(str(e))
+
+
+def _cmd_fleet(args) -> int:
+    from fedrec_tpu.obs.fleet import build_fleet_report, render_fleet_text
+
+    workers = _load_fleet(args.path)
+    if isinstance(workers, int):
+        return workers
+    report = build_fleet_report(workers)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_fleet_text(report))
+    return 0
+
+
+def _cmd_fleet_trace(args) -> int:
+    from fedrec_tpu.obs.fleet import build_fleet_trace
+
+    workers = _load_fleet(args.path)
+    if isinstance(workers, int):
+        return workers
+    doc = build_fleet_trace(workers)
+    out = Path(args.out) if args.out else Path(args.path) / "fleet_trace.json"
+    try:
+        with open(out, "w") as f:
+            json.dump(doc, f)
+    except OSError as e:
+        return _fail(f"cannot write merged trace to {out}: {e}")
+    n_ev = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(
+        f"merged {n_ev} events from {len(doc['otherData']['workers'])} "
+        f"worker track(s) -> {out} (load in https://ui.perfetto.dev)"
+    )
     return 0
 
 
@@ -353,6 +413,26 @@ def build_parser() -> argparse.ArgumentParser:
     rp.add_argument("--json", action="store_true",
                     help="machine-readable verdict")
     rp.set_defaults(fn=_cmd_replay)
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet-wide report over worker_* obs dirs (straggler/"
+             "critical-path attribution, membership timeline, DCN bytes)",
+    )
+    fl.add_argument("path", help="shared obs dir / collector dir / one "
+                                 "worker's obs dir")
+    fl.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of text")
+    fl.set_defaults(fn=_cmd_fleet)
+    ft = sub.add_parser(
+        "fleet-trace",
+        help="merge every worker's spans into ONE clock-aligned "
+             "Chrome/Perfetto trace with per-worker tracks",
+    )
+    ft.add_argument("path", help="shared obs dir / collector dir / one "
+                                 "worker's obs dir")
+    ft.add_argument("-o", "--out", default=None,
+                    help="output path (default <dir>/fleet_trace.json)")
+    ft.set_defaults(fn=_cmd_fleet_trace)
     return p
 
 

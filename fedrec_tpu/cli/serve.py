@@ -172,12 +172,18 @@ def main(argv: list[str] | None = None) -> int:
     if service is None:
         return 2
     service.warmup()  # compile every bucket before accepting traffic
-    from fedrec_tpu.obs import get_tracer
+    import os as _os
+
+    from fedrec_tpu.obs import ensure_fleet_identity, get_tracer
 
     # spans are only worth their memory when something will save them:
     # without --obs-dir this process never writes trace.json, so recording
     # per-request spans would just fill the bounded buffer with dead weight
     get_tracer().enabled = bool(args.obs_dir)
+    # fleet correlation keys: serving spans/snapshots join the fleet's
+    # training artifacts by worker id (FEDREC_WORKER_ID when the operator
+    # co-locates a server with a training worker)
+    ensure_fleet_identity(worker=_os.environ.get("FEDREC_WORKER_ID") or "serve")
     jsonl = None
     if args.obs_dir:
         from pathlib import Path as _Path

@@ -464,6 +464,26 @@ class HealthConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet-wide telemetry (``fedrec_tpu.obs.fleet``).
+
+    ``collector`` names the TCP JSON-lines telemetry collector this
+    worker pushes registry snapshots + completed spans to at round
+    cadence — standalone (``CollectorServer``) or riding the membership
+    service's port (``python -m fedrec_tpu.parallel.membership ...
+    --telemetry-dir D``).  Empty = no pushes; the per-worker
+    ``obs.dir/worker_*`` artifacts remain the lossless offline source
+    either way (``fedrec-obs fleet`` merges them post-hoc), so a
+    no-collector run loses nothing.  Push failures are counted
+    (``obs.fleet_push_failures_total``), never raised.
+    """
+
+    collector: str = ""                # HOST:PORT; "" = offline artifacts only
+    push_every: int = 1                # rounds between telemetry pushes
+    push_timeout_s: float = 5.0        # per-push TCP deadline
+
+
+@dataclass
 class ObsConfig:
     """Unified telemetry (fedrec_tpu.obs): registry snapshots + host spans.
 
@@ -484,6 +504,7 @@ class ObsConfig:
     # 0 = unbounded.
     jsonl_max_mb: float = 0.0
     health: HealthConfig = field(default_factory=HealthConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 @dataclass

@@ -21,6 +21,13 @@ Three pieces, one namespace:
   forensics on non-finite/divergence triggers (``fedrec-obs replay``).
 * :mod:`fedrec_tpu.obs.device` — device-layer watchdogs: XLA recompile
   accounting with shape provenance and round-boundary HBM gauges.
+* :mod:`fedrec_tpu.obs.fleet` — fleet-wide observability: worker/rank/
+  membership-epoch correlation keys on every span and JSONL record, a
+  round-cadence telemetry collector with an offline ``worker_*`` merge
+  fallback, the merged clock-aligned distributed trace
+  (``fedrec-obs fleet-trace``), per-round straggler/critical-path
+  attribution (``fedrec-obs fleet``), and counter-baseline continuity
+  across supervisor respawns.
 
 The package imports no JAX at module level — serving and CLI paths pull
 it in cheaply (health/device import jax lazily inside functions).
@@ -46,6 +53,15 @@ from fedrec_tpu.obs.report import (
     rotate_jsonl,
 )
 from fedrec_tpu.obs.tracing import Tracer, get_tracer, set_tracer
+from fedrec_tpu.obs.fleet import (
+    FleetPusher,
+    TelemetryCollector,
+    ensure_fleet_identity,
+    get_fleet_identity,
+    restore_counter_baseline,
+    save_counter_baseline,
+    set_fleet_identity,
+)
 from fedrec_tpu.obs.health import (
     FlightRecorder,
     HealthMonitor,
@@ -61,24 +77,31 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "CompileWatchdog",
     "Counter",
+    "FleetPusher",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "TelemetryCollector",
     "Tracer",
     "TrainingHealthError",
     "build_report",
     "dump_artifacts",
+    "ensure_fleet_identity",
+    "get_fleet_identity",
     "get_registry",
     "get_tracer",
     "load_jsonl",
     "load_trace",
     "render_text",
+    "restore_counter_baseline",
     "rotate_jsonl",
     "sample_device_memory",
     "sanitize_prom_name",
+    "save_counter_baseline",
     "set_active_watchdog",
+    "set_fleet_identity",
     "set_registry",
     "set_tracer",
 ]

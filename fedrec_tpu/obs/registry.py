@@ -261,6 +261,21 @@ class MetricsRegistry:
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], None]] = []
         self.created_at = time.time()
+        # fleet correlation keys (obs.fleet.set_fleet_identity): stamped
+        # into every snapshot as its "fleet" key and merged into each
+        # MetricLogger JSONL record, so artifacts from different
+        # processes are joinable offline
+        self._context: dict[str, Any] = {}
+
+    def set_context(self, **kv: Any) -> None:
+        """Replace the fleet label set carried by subsequent snapshots."""
+        with self._lock:
+            self._context = {k: v for k, v in kv.items() if v is not None}
+
+    @property
+    def context(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._context)
 
     # ------------------------------------------------------- instruments
     def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
@@ -340,9 +355,11 @@ class MetricsRegistry:
         self._run_collectors()
         with self._lock:
             metrics = list(self._metrics.items())
+            context = dict(self._context)
         return {
             "kind": "registry_snapshot",
             "ts": time.time(),
+            **({"fleet": context} if context else {}),
             "metrics": {
                 name: {
                     "kind": m.kind,

@@ -391,19 +391,28 @@ def build_report(
         epoch = snapshot_value(last, "fed.membership_epoch")
         if epoch is not None:
             mem: dict[str, Any] = {"epoch": epoch}
-            for key, name in (
-                ("world", "fed.membership_world"),
-                ("shrinks", "fed.membership_shrinks"),
-                ("rejoins", "fed.membership_rejoins"),
-                ("lease_misses", "fed.membership_lease_misses"),
-                ("heartbeat_failures", "fed.lease_heartbeat_failures"),
-                ("reforms", "fed.membership_reforms_total"),
-                ("reshard_seconds", "shard.reshard_seconds"),
-                ("rows_recovered", "shard.reshard_rows_recovered_total"),
+            # shrinks/rejoins/lease_misses: the service's OWN counters
+            # (its obs trio, PR-13) — the `_total` names; the legacy
+            # pre-PR-13 worker-mirrored gauge names still render from
+            # old artifacts
+            for key, names in (
+                ("world", ("fed.membership_world",)),
+                ("shrinks", ("fed.membership_shrinks_total",
+                             "fed.membership_shrinks")),
+                ("rejoins", ("fed.membership_rejoins_total",
+                             "fed.membership_rejoins")),
+                ("lease_misses", ("fed.membership_lease_misses_total",
+                                  "fed.membership_lease_misses")),
+                ("heartbeat_failures", ("fed.lease_heartbeat_failures",)),
+                ("reforms", ("fed.membership_reforms_total",)),
+                ("reshard_seconds", ("shard.reshard_seconds",)),
+                ("rows_recovered", ("shard.reshard_rows_recovered_total",)),
             ):
-                v = snapshot_value(last, name)
-                if v is not None:
-                    mem[key] = v
+                for name in names:
+                    v = snapshot_value(last, name)
+                    if v is not None:
+                        mem[key] = v
+                        break
             report["membership"] = mem
 
         # ---- cap overflows
@@ -651,11 +660,19 @@ def render_text(report: dict) -> str:
     return "\n".join(lines)
 
 
-def dump_artifacts(obs_dir, registry=None, tracer=None) -> dict[str, str]:
+def dump_artifacts(
+    obs_dir, registry=None, tracer=None, trace_tag: str | None = None
+) -> dict[str, str]:
     """Write the run's observability artifacts into ``obs_dir``:
     ``metrics.jsonl`` (append one final registry snapshot), ``trace.json``
     (Perfetto host spans), ``prometheus.txt`` (text exposition).  Shared
-    shutdown path for the Trainer, ``fedrec-serve`` and ``serve_load``."""
+    shutdown path for the Trainer, ``fedrec-serve`` and ``serve_load``.
+
+    ``trace_tag`` (elastic workers pass their membership epoch, e.g.
+    ``"e2"``) ADDITIONALLY writes the trace as ``trace_<tag>.json`` —
+    each incarnation's span history survives the respawn that would
+    otherwise overwrite ``trace.json``, and ``fedrec-obs fleet-trace``
+    merges every incarnation into the fleet timeline."""
     from fedrec_tpu.obs.registry import get_registry
     from fedrec_tpu.obs.tracing import get_tracer
 
@@ -670,6 +687,10 @@ def dump_artifacts(obs_dir, registry=None, tracer=None) -> dict[str, str]:
     }
     registry.write_snapshot(paths["metrics"])
     tracer.save(paths["trace"])
+    if trace_tag:
+        tagged = str(out_dir / f"trace_{trace_tag}.json")
+        paths["trace_tagged"] = tagged
+        tracer.save(tagged)
     with open(paths["prometheus"], "w") as f:
         f.write(registry.to_prometheus())
     return paths

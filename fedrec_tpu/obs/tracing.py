@@ -59,6 +59,21 @@ class Tracer:
         self._events: list[dict] = []
         self.dropped = 0
         self._pid = os.getpid()
+        # fleet correlation keys (obs.fleet.set_fleet_identity) merged
+        # into every recorded event's args — worker/rank/membership_epoch
+        # labels that make multi-process traces joinable offline
+        self._context: dict[str, Any] = {}
+
+    @property
+    def epoch_unix(self) -> float:
+        """Wall-clock time at this tracer's epoch — the anchor the fleet
+        merger uses for coarse cross-process clock alignment."""
+        return self._epoch_unix
+
+    def set_context(self, **kv: Any) -> None:
+        """Replace the label set stamped into every subsequent event's
+        args (explicit per-event args win on key collision)."""
+        self._context = {k: v for k, v in kv.items() if v is not None}
 
     # ------------------------------------------------------------- clock
     def now(self) -> float:
@@ -72,6 +87,8 @@ class Tracer:
     def _append(self, ev: dict) -> None:
         if not self.enabled:
             return
+        if self._context:
+            ev["args"] = {**self._context, **ev.get("args", {})}
         with self._lock:
             if len(self._events) >= self.capacity:
                 self.dropped += 1

@@ -45,7 +45,6 @@ Run standalone (the elastic smoke's service process)::
 
 from __future__ import annotations
 
-import json
 import socket
 import threading
 import time
@@ -132,10 +131,20 @@ class MembershipServer:
         lease_ms: float | None = None,
         heartbeat_ms: float | None = None,
         formation_grace_ms: float | None = None,
+        collector=None,
+        obs_dir: str | None = None,
     ):
         self.host = host
         self.port = port
         self.target_world = int(target_world)
+        # fleet telemetry riding the membership port (one control-plane
+        # address per federation): a fedrec_tpu.obs.fleet
+        # TelemetryCollector answers telemetry_push/telemetry_status here
+        self.collector = collector
+        # the service's OWN obs artifact trio (metrics.jsonl/trace.json/
+        # prometheus.txt) — its shrink/rejoin/lease counters used to be
+        # visible only second-hand through worker mirror gauges
+        self.obs_dir = obs_dir
         # None = adopt from the first join request that carries a policy
         # (the workers' shared ``fed.elastic`` section is then the ONE
         # source of lease/formation policy); an explicit server-side value
@@ -158,6 +167,7 @@ class MembershipServer:
         self._srv: socket.socket | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._instrument()
 
     # ------------------------------------------------- effective policy
     @property
@@ -192,6 +202,60 @@ class MembershipServer:
         if self._min_world is None and policy.get("min_world"):
             self._min_world = int(policy["min_world"])
 
+    # --------------------------------------------------------------- obs
+    def _instrument(self) -> None:
+        """The service's registry instruments — REAL monotonic counters
+        in its own process (the worker-side mirror gauges these replace
+        under-reported across worker respawns; see
+        docs/OBSERVABILITY.md, Membership)."""
+        from fedrec_tpu.obs import get_registry, get_tracer
+
+        reg = get_registry()
+        self._tracer = get_tracer()
+        self._m_shrinks = reg.counter(
+            "fed.membership_shrinks_total",
+            "epochs that formed SMALLER than their predecessor "
+            "(shrink-and-continue events; service-owned)",
+        )
+        self._m_rejoins = reg.counter(
+            "fed.membership_rejoins_total",
+            "workers that re-entered a later epoch after missing one "
+            "(service-owned)",
+        )
+        self._m_lease_misses = reg.counter(
+            "fed.membership_lease_misses_total",
+            "heartbeat leases the service expired — the failure detector "
+            "firing (service-owned)",
+        )
+        self._g_epoch = reg.gauge(
+            "fed.membership_epoch",
+            "membership epoch this worker's world formed at",
+        )
+        self._g_world = reg.gauge(
+            "fed.membership_world",
+            "world size of this worker's membership epoch",
+        )
+
+    def dump_obs(self) -> None:
+        """Write/refresh the service's artifact trio (no-op without
+        ``obs_dir``); called on membership-state changes by the
+        standalone main loop and on shutdown, so the membership timeline
+        is inspectable while the federation is still running.  The event
+        log is size-rotated (one ``.1`` level, same policy as
+        ``obs.jsonl_max_mb``) so a long-lived control plane cannot grow
+        it without bound."""
+        if not self.obs_dir:
+            return
+        from pathlib import Path
+
+        from fedrec_tpu.obs import dump_artifacts, rotate_jsonl
+
+        try:
+            rotate_jsonl(Path(self.obs_dir) / "metrics.jsonl", 64.0)
+            dump_artifacts(self.obs_dir)
+        except OSError:
+            pass  # a full disk must not take the control plane down
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MembershipServer":
         srv = socket.create_server((self.host, self.port))
@@ -218,6 +282,7 @@ class MembershipServer:
         with self._lock:
             for j in self._joiners.values():
                 j.event.set()
+        self.dump_obs()
 
     @property
     def address(self) -> str:
@@ -238,26 +303,25 @@ class MembershipServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            try:
-                conn.settimeout(300.0)
-                buf = b""
-                while b"\n" not in buf:
-                    chunk = conn.recv(65536)
-                    if not chunk:
-                        return
-                    buf += chunk
-                req = json.loads(buf.split(b"\n", 1)[0].decode())
-                resp = self._handle(req)
-                conn.sendall((json.dumps(resp) + "\n").encode())
-            except (OSError, ValueError, KeyError):
-                try:
-                    conn.sendall(b'{"error": "bad request"}\n')
-                except OSError:
-                    pass
+        # ONE wire-protocol implementation for the control plane: the
+        # shared JSON-lines exchange (obs.fleet also fronts the telemetry
+        # collector with it, so the two servers cannot drift). The long
+        # timeout is membership-specific: a ``join`` parks the
+        # connection's thread until epoch formation.
+        from fedrec_tpu.obs.fleet import serve_json_line
+
+        serve_json_line(conn, self._handle, timeout_s=300.0)
 
     def _handle(self, req: dict) -> dict:
         cmd = req.get("cmd")
+        if cmd in ("telemetry_push", "telemetry_status"):
+            if self.collector is None:
+                return {
+                    "error": "no telemetry collector attached — start the "
+                             "service with --telemetry-dir (or run a "
+                             "standalone obs.fleet CollectorServer)"
+                }
+            return self.collector.handle(req)
         if cmd == "heartbeat":
             return self._heartbeat(str(req["worker"]), int(req.get("epoch", -1)))
         if cmd == "join":
@@ -283,6 +347,7 @@ class MembershipServer:
             return {"epoch": self.epoch, "reform": bool(reform)}
 
     def _join(self, worker: str, coord: str, policy: dict) -> dict:
+        self._tracer.instant("membership_worker_join", worker=str(worker))
         with self._lock:
             self._adopt_policy_locked(policy)
             j = _Joiner(worker=worker, coord_candidate=coord, arrived_at=_now())
@@ -369,11 +434,21 @@ class MembershipServer:
         if self.epoch > 0:
             if world < prev_world:
                 self.shrinks += 1
+                self._m_shrinks.inc()
             rejoined = set(order) - prev_set
             if prev_set and rejoined:
                 self.rejoins += len(rejoined)
+                self._m_rejoins.inc(len(rejoined))
         self.epoch_history.append(
             {"epoch": self.epoch, "world": world, "workers": list(order)}
+        )
+        self._g_epoch.set(float(self.epoch))
+        self._g_world.set(float(world))
+        # the formation instant is the merged fleet trace's membership
+        # timeline (kill -> shrink -> rejoin reads straight off the track)
+        self._tracer.instant(
+            "membership_epoch_formed",
+            epoch=self.epoch, world=world, workers=list(order),
         )
         self._joiners.clear()
         self._window_opened = None
@@ -399,6 +474,10 @@ class MembershipServer:
                 for w in dead:
                     del self._members[w]
                     self.lease_misses += 1
+                    self._m_lease_misses.inc()
+                    self._tracer.instant(
+                        "membership_lease_expired", worker=str(w)
+                    )
                     self._reform_needed = True
                 self._maybe_form_locked()
 
@@ -457,22 +536,15 @@ class MembershipClient:
     # ---------------------------------------------------------------- rpcs
     def _call(self, req: dict, timeout_s: float | None = None) -> dict:
         timeout = timeout_s if timeout_s is not None else self.rpc_timeout_s
-        with socket.create_connection(
-            (self.host, self.port), timeout=timeout
-        ) as conn:
-            conn.sendall((json.dumps(req) + "\n").encode())
-            buf = b""
-            while b"\n" not in buf:
-                chunk = conn.recv(65536)
-                if not chunk:
-                    break
-                buf += chunk
-        if not buf:
-            raise MembershipError("empty response from membership service")
-        resp = json.loads(buf.split(b"\n", 1)[0].decode())
-        if "error" in resp:
-            raise MembershipError(resp["error"])
-        return resp
+        # the shared client wire helper (obs.fleet also pushes telemetry
+        # with it): transport failures surface as OSError, protocol /
+        # {"error": ...} replies as ValueError -> MembershipError
+        from fedrec_tpu.obs.fleet import request_json_line
+
+        try:
+            return request_json_line(self.host, self.port, req, timeout)
+        except ValueError as e:
+            raise MembershipError(str(e)) from e
 
     def _local_host_toward_service(self) -> str:
         """The local interface address that ROUTES TO the membership
@@ -592,16 +664,18 @@ def elastic_policy(elastic_cfg) -> dict:
 
 def publish_membership_metrics(
     assignment: EpochAssignment | None = None,
-    status: dict | None = None,
     client: "MembershipClient | None" = None,
     reforms: int = 0,
 ) -> None:
     """THE one registration site for the worker-side membership metrics
     (docs/OBSERVABILITY.md, Membership): the epoch/world gauges from this
-    worker's seat, the service-owned totals (shrinks / rejoins / lease
-    misses — monotonic on the SERVER, mirrored here as gauges because a
-    respawned worker's registry restarts while the service's history does
-    not), this worker's failed lease renewals, and its reform departures.
+    worker's seat, this worker's failed lease renewals, and its reform
+    departures.  The service-owned totals (shrinks / rejoins / lease
+    misses) live as REAL counters in the service's own obs artifact trio
+    (``--obs-dir`` on the standalone service) — the pre-PR-13 workaround
+    of mirroring them into each worker as gauges is retired: worker
+    registries restart on respawn while the service's history does not,
+    and the fleet report reads the service's artifacts directly.
     """
     from fedrec_tpu.obs import get_registry
 
@@ -615,20 +689,6 @@ def publish_membership_metrics(
             "fed.membership_world",
             "world size of this worker's membership epoch",
         ).set(float(assignment.world))
-    if status is not None:
-        for key, name, help_ in (
-            ("shrinks", "fed.membership_shrinks",
-             "epochs that formed SMALLER than their predecessor "
-             "(service total, mirrored)"),
-            ("rejoins", "fed.membership_rejoins",
-             "workers that re-entered a later epoch after missing one "
-             "(service total, mirrored)"),
-            ("lease_misses", "fed.membership_lease_misses",
-             "heartbeat leases the service expired (service total, "
-             "mirrored)"),
-        ):
-            if key in status:
-                reg.gauge(name, help_).set(float(status[key]))
     if client is not None:
         reg.gauge(
             "fed.lease_heartbeat_failures",
@@ -659,19 +719,62 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--lease-ms", type=float, default=None)
     parser.add_argument("--heartbeat-ms", type=float, default=None)
     parser.add_argument("--formation-grace-ms", type=float, default=None)
+    parser.add_argument("--obs-dir", default=None,
+                        help="write the service's OWN obs artifact trio "
+                             "here (refreshed every few seconds and on "
+                             "shutdown) — the authoritative membership "
+                             "timeline the fleet report/trace reads; name "
+                             "it worker_membership under the fleet's "
+                             "shared obs root so fedrec-obs fleet "
+                             "discovers it")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="also act as the fleet telemetry collector "
+                             "(fedrec_tpu.obs.fleet) on THIS port: "
+                             "workers' obs.fleet.collector pushes land "
+                             "as worker_* dirs under this directory")
     args = parser.parse_args(argv)
     host, port = args.address.rsplit(":", 1)
+    collector = None
+    if args.telemetry_dir:
+        from fedrec_tpu.obs.fleet import TelemetryCollector
+
+        collector = TelemetryCollector(args.telemetry_dir)
+    if args.obs_dir:
+        from fedrec_tpu.obs.fleet import set_fleet_identity
+
+        set_fleet_identity(worker="membership")
     server = MembershipServer(
         host=host, port=int(port),
         target_world=args.target_world, min_world=args.min_world,
         lease_ms=args.lease_ms, heartbeat_ms=args.heartbeat_ms,
         formation_grace_ms=args.formation_grace_ms,
+        collector=collector, obs_dir=args.obs_dir,
     ).start()
     print(f"[membership] serving on {server.address}", flush=True)
+
+    # a SIGTERM'd service (the smoke's cleanup kill) must still run the
+    # finally below — the final artifact dump is the membership timeline
+    import signal
+
+    def _term(signum, frame):  # noqa: ARG001 — signal handler signature
+        raise SystemExit(0)
+
     try:
+        signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError):
+        pass  # not the main thread / unsupported platform: best effort
+    try:
+        # change-driven artifact refresh: a snapshot line per membership
+        # EVENT (join/leave/expiry/formation), not per poll tick — an
+        # idle federation's event log stays flat
+        last_status = None
         while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
+            time.sleep(5)
+            status = server.status() if args.obs_dir else None
+            if args.obs_dir and status != last_status:
+                server.dump_obs()
+                last_status = status
+    except (KeyboardInterrupt, SystemExit):
         pass
     finally:
         server.stop()

@@ -645,6 +645,16 @@ class Trainer:
         self.registry = get_registry()
         self.tracer = get_tracer()
         self.tracer.capacity = cfg.obs.trace_capacity
+        # fleet correlation keys (fedrec_tpu.obs.fleet): every span,
+        # registry snapshot and MetricLogger record carries worker/rank
+        # labels so multi-process artifacts are joinable — the
+        # coordinator CLI stamps the stable elastic identity first and
+        # this is then a no-op
+        from fedrec_tpu.obs.fleet import ensure_fleet_identity
+
+        ensure_fleet_identity(
+            worker=str(jax.process_index()), rank=jax.process_index()
+        )
         self._m_rounds = self.registry.counter(
             "train.rounds_total", "federated rounds completed"
         )
@@ -896,6 +906,21 @@ class Trainer:
             registry=self.registry,
             jsonl_max_mb=cfg.obs.jsonl_max_mb,
         )
+        # round-cadence fleet telemetry (obs.fleet.collector): registry
+        # snapshots + completed spans pushed to the fleet collector; push
+        # failures are counted, never raised, and the obs.dir artifacts
+        # stay the lossless offline source
+        self.fleet_pusher = None
+        if cfg.obs.fleet.collector:
+            from fedrec_tpu.obs.fleet import FleetPusher
+
+            self.fleet_pusher = FleetPusher(
+                cfg.obs.fleet.collector,
+                registry=self.registry,
+                tracer=self.tracer,
+                timeout_s=cfg.obs.fleet.push_timeout_s,
+                push_every=cfg.obs.fleet.push_every,
+            )
 
         # ---- training-health flight recorder (fedrec_tpu.obs.health) +
         # device watchdogs (fedrec_tpu.obs.device). The monitor digests the
@@ -2652,6 +2677,10 @@ class Trainer:
                 except Exception as e:  # noqa: BLE001 — never mask the training error
                     print(f"[trainer] could not write obs artifacts: "
                           f"{type(e).__name__}: {e}")
+            if self.fleet_pusher is not None:
+                # final push on every exit path (never raises; a dead
+                # collector only counts a failure)
+                self.fleet_pusher.push(final=True)
             try:
                 self.logger.finish()
             except Exception as e:  # noqa: BLE001 — a wandb flush error must
@@ -2801,3 +2830,5 @@ class Trainer:
             # snapshots are the event log's bulk on long runs
             rotate_jsonl(self._obs_dir / "metrics.jsonl", cfg.obs.jsonl_max_mb)
             self.registry.write_snapshot(self._obs_dir / "metrics.jsonl")
+        if self.fleet_pusher is not None:
+            self.fleet_pusher.maybe_push(round_idx)

@@ -84,7 +84,16 @@ class MetricLogger:
                 # serving emits real Nones for not-yet-populated percentiles);
                 # everything else is stringified
                 clean[k] = v if isinstance(v, str) or v is None else str(v)
-        record = {"step": step, "elapsed_sec": round(time.time() - self._t0, 2), **clean}
+        # fleet correlation keys (obs.fleet): worker/rank/membership_epoch
+        # ride every JSONL record so multi-process event logs are joinable
+        # offline; explicit metric keys win on collision
+        fleet = self._registry.context
+        record = {
+            "step": step,
+            "elapsed_sec": round(time.time() - self._t0, 2),
+            **fleet,
+            **clean,
+        }
         line = json.dumps(record)
         print(line, file=self.stream, flush=True)
         if self._jsonl_path is not None:

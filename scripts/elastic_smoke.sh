@@ -17,7 +17,13 @@
 #        final evaluation runs;
 #     4. ACCOUNT: the membership service's counters match the script —
 #        exactly one shrink, exactly one rejoin, epoch history
-#        world 4 -> 3 -> 4.
+#        world 4 -> 3 -> 4;
+#     5. FLEET (ISSUE-13 acceptance): the per-worker obs artifacts +
+#        round-cadence telemetry pushes (collector riding the membership
+#        port) merge into ONE Perfetto trace whose per-worker tracks show
+#        the kill -> shrink -> rejoin sequence as membership instants,
+#        and `fedrec-obs fleet` names a critical-path worker for every
+#        round — from the offline worker_* merge AND the collector dir.
 #
 #   scripts/elastic_smoke.sh     # or: make elastic-smoke
 #
@@ -42,6 +48,8 @@ env -u PALLAS_AXON_POOL_IPS \
     PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     python -m fedrec_tpu.parallel.membership "127.0.0.1:$MPORT" \
     --target-world 4 \
+    --obs-dir "$OUT/obs/worker_membership" \
+    --telemetry-dir "$OUT/pushed" \
     > "$OUT/membership.log" 2>&1 &
 MEM_PID=$!
 cleanup() { kill "$MEM_PID" 2>/dev/null || true; }
@@ -74,6 +82,8 @@ run_worker() {
         --set fed.elastic.lease_ms=5000 \
         --set fed.elastic.heartbeat_ms=1000 \
         --set fed.elastic.formation_grace_ms=6000 \
+        --set "obs.dir=$OUT/obs" \
+        --set "obs.fleet.collector=127.0.0.1:$MPORT" \
         > "$OUT/worker_$1.log" 2>&1
 }
 
@@ -159,6 +169,94 @@ for line in w0.splitlines():
 assert (rounds - 1) in final_rounds, sorted(final_rounds)
 assert evaled, "the final evaluation never ran"
 print("[elastic-smoke] counters + logs match the script")
+PY
+
+# ------------------------------------------------------- [5] the fleet leg
+echo "[elastic-smoke] fleet leg: merged trace + critical-path report"
+obs_cli() {
+    env -u PALLAS_AXON_POOL_IPS \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m fedrec_tpu.cli.obs "$@"
+}
+obs_cli fleet "$OUT/obs" > "$OUT/fleet_report.txt"
+obs_cli fleet "$OUT/obs" --json > "$OUT/fleet_report.json"
+obs_cli fleet-trace "$OUT/obs" -o "$OUT/fleet_trace.json"
+obs_cli fleet "$OUT/pushed" --json > "$OUT/fleet_pushed.json"
+
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" ROUNDS="$ROUNDS" \
+    python - <<'PY'
+import json
+import os
+from pathlib import Path
+
+out = Path(os.environ["OUT"])
+rounds = int(os.environ["ROUNDS"])
+
+# -- the offline worker_* merge: every worker + the service discovered
+rep = json.loads((out / "fleet_report.json").read_text())
+workers = set(rep["workers"])
+assert {"0", "1", "2", "3", "membership"} <= workers, workers
+assert rep["workers"]["membership"]["role"] == "membership_service"
+
+# -- membership timeline: kill -> shrink -> rejoin reads off the report
+hist = [h["world"] for h in rep["membership"]["epoch_history"]]
+assert hist == [4, 3, 4], hist
+assert rep["membership"]["shrinks"] == 1, rep["membership"]
+assert rep["membership"]["rejoins"] == 1, rep["membership"]
+
+# -- a named critical-path worker for EVERY round (the acceptance bar)
+by_round = {r["round"]: r for r in rep["rounds"]}
+for r in range(rounds):
+    assert r in by_round, f"round {r} missing from the fleet report"
+    row = by_round[r]
+    assert row["critical_worker"] in {"0", "1", "2", "3"}, row
+    assert row["round_ms"] > 0, row
+assert rep["critical_path"], "no times-on-critical-path totals"
+
+# -- the merged trace: one doc, >= 5 tracks, kill/shrink/rejoin instants
+doc = json.loads((out / "fleet_trace.json").read_text())
+assert len(doc["otherData"]["workers"]) >= 5, doc["otherData"]["workers"]
+evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+ts = [e["ts"] for e in evs]
+assert ts == sorted(ts), "merged trace ts not monotonic"
+names = [e["name"] for e in evs]
+formed = [e for e in evs if e["name"] == "membership_epoch_formed"]
+assert [f["args"]["world"] for f in formed] == [4, 3, 4], formed
+expired = [e for e in evs if e["name"] == "membership_lease_expired"]
+assert any(e["args"]["worker"] == "2" for e in expired), \
+    "the chaos-killed worker's lease expiry is not in the merged trace"
+assert "membership_worker_join" in names
+assert "fed_round" in names
+# per-worker tracks really carry the correlation keys
+fr = [e for e in evs if e["name"] == "fed_round"]
+assert {e["args"].get("worker") for e in fr} >= {"0", "1", "3"}, \
+    "fed_round spans lost their worker labels"
+
+# -- the collector got round-cadence pushes and renders the same story
+pushed = json.loads((out / "fleet_pushed.json").read_text())
+assert {"0", "1", "2", "3"} <= set(pushed["workers"]), pushed["workers"]
+assert pushed["rounds"], "no rounds in the collector-side report"
+# the killed worker's pre-kill rounds survived ONLY via pushes: its
+# epoch-0 spans must be present in the collector merge
+w2_rounds = {r["round"] for r in pushed["rounds"] if "2" in r["workers"]}
+assert 0 in w2_rounds or 1 in w2_rounds, \
+    "worker 2's pre-kill rounds never reached the collector"
+
+# -- counter continuity: a respawned worker's totals resumed (monotone)
+from fedrec_tpu.obs.report import load_jsonl, snapshot_value
+_, snaps = load_jsonl(out / "obs" / "worker_2" / "metrics.jsonl")
+totals = [
+    v for s in snaps
+    if (v := snapshot_value(s, "train.rounds_total")) is not None
+]
+assert totals == sorted(totals), f"worker 2 totals not monotone: {totals}"
+assert totals and totals[-1] >= rounds - 2, totals
+
+print("[elastic-smoke] fleet leg OK "
+      f"({len(rep['rounds'])} rounds attributed, "
+      f"{len(workers)} workers merged)")
 PY
 
 echo "[elastic-smoke] OK"

@@ -90,6 +90,29 @@ echo "== run reports =="
 python -m fedrec_tpu.cli.obs report "$OUT/train"
 python -m fedrec_tpu.cli.obs report "$OUT/serve"
 
+echo "== fleet leg (single-worker degenerate) =="
+# fedrec-obs fleet/fleet-trace must degrade gracefully to one obs dir:
+# every round attributed to worker 0, the merged trace valid Perfetto
+python -m fedrec_tpu.cli.obs fleet "$OUT/train" --json > "$OUT/fleet.json"
+python -m fedrec_tpu.cli.obs fleet-trace "$OUT/train" \
+    -o "$OUT/fleet_trace.json" > /dev/null
+python - "$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+rep = json.load(open(f"{out}/fleet.json"))
+assert set(rep["workers"]) == {"0"}, rep["workers"]
+assert len(rep["rounds"]) == 2, rep.get("rounds")
+assert all(r["critical_worker"] == "0" and r["gate_ms"] == 0.0
+           for r in rep["rounds"]), rep["rounds"]
+doc = json.load(open(f"{out}/fleet_trace.json"))
+evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+ts = [e["ts"] for e in evs]
+assert ts == sorted(ts), "merged trace ts not monotonic"
+assert any(e["name"] == "fed_round" and e["args"].get("worker") == "0"
+           for e in evs), "fed_round spans lost their worker label"
+print("  fleet: 2 rounds attributed to worker 0, merged trace valid")
+EOF
+
 echo "== [4/4] forced-NaN flight-recorder round-trip =="
 # inf lr: the first optimizer update goes non-finite, the sentry trips,
 # the run must ABORT (nonzero exit) after dumping forensics

@@ -220,17 +220,17 @@ def test_publish_membership_metrics_registers():
         epoch=3, rank=1, world=2, coordinator="h:1", lease_ms=1.0,
         heartbeat_ms=1.0,
     )
-    publish_membership_metrics(
-        assignment=asg,
-        status={"shrinks": 1, "rejoins": 2, "lease_misses": 3},
-        reforms=1,
-    )
+    publish_membership_metrics(assignment=asg, reforms=1)
     snap = get_registry().snapshot()["metrics"]
     assert snap["fed.membership_epoch"]["values"][0]["value"] == 3.0
     assert snap["fed.membership_world"]["values"][0]["value"] == 2.0
-    assert snap["fed.membership_shrinks"]["values"][0]["value"] == 1.0
-    assert snap["fed.membership_rejoins"]["values"][0]["value"] == 2.0
     assert snap["fed.membership_reforms_total"]["values"][0]["value"] >= 1.0
+    # the PR-12 mirror gauges are retired: service totals live as REAL
+    # counters in the service's own registry/artifacts (PR-13), never as
+    # worker-side gauges a respawn would under-report through
+    assert "fed.membership_shrinks" not in snap
+    assert "fed.membership_rejoins" not in snap
+    assert "fed.membership_lease_misses" not in snap
 
 
 # ------------------------------------------------- reform signal plumbing
