@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -47,6 +47,20 @@ elastic-smoke:
 # leg on the local backend; banks benchmarks/table_capacity.json
 table-capacity:
 	@python benchmarks/table_capacity.py
+
+# quality-regression gate: seeded CPU run -> sliced-eval digest; banks a
+# provenance-stamped benchmarks/quality_gate.json on first run, then
+# fails (naming the slice) when any slice's AUC regresses beyond the
+# noise-aware threshold vs the banked baseline
+quality-gate:
+	@python benchmarks/quality_gate.py
+
+# model-quality smoke: sliced-eval telemetry end to end (2-round CPU run
+# with obs.quality on -> Quality report section + slice gauges), a store
+# drift-probe leg (corrupted table push -> non-zero serve.drift_* BEFORE
+# the swap), and a forced-regression gate-failure leg
+quality-smoke:
+	@bash scripts/quality_smoke.sh
 
 # communication-cost benchmark: measured per-codec wire buffers of the
 # flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);

@@ -178,10 +178,12 @@ def run_codec_tradeoff(
         finally:
             set_registry(old_reg)
 
+        # unified key scheme (val_auc); legacy valid_auc kept readable so
+        # the helper also digests pre-rename event logs
         aucs = [
-            (int(r["round"]), float(r["valid_auc"]))
+            (int(r["round"]), float(r.get("val_auc", r.get("valid_auc"))))
             for r in sorted(records, key=lambda r: r.get("round", 0))
-            if "valid_auc" in r and "round" in r
+            if ("val_auc" in r or "valid_auc" in r) and "round" in r
         ]
         elapsed = {
             int(r["round"]): float(r["elapsed_sec"])

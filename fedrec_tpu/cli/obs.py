@@ -25,6 +25,14 @@ Subcommands:
       Prometheus text exposition (for a run that predates, or lost, its
       prometheus.txt).
 
+  fedrec-obs quality <dir | metrics.jsonl> [--json]
+      Model-quality report off the last registry snapshot: every eval
+      slice's AUC/MRR/NDCG + impression count (ascending AUC, so the
+      worst stratum leads), the calibration reliability table + ECE,
+      score separation, per-client AUC with the quality-outlier count,
+      and the serving store's last pre-swap drift verdict.  Exit 2 when
+      the run carried no quality telemetry (obs.quality.enabled=false).
+
   fedrec-obs replay <dir | flightrec dir> [--max-steps N] [--json]
       Re-execute the flight-recorder dump's recorded steps on CPU from
       the dumped chunk-entry state — deterministically confirming (and
@@ -141,6 +149,107 @@ def _cmd_prom(args) -> int:
     # the SAME renderer the live {"cmd": "prometheus"} endpoint uses —
     # offline output cannot drift from the wire exposition
     print(snapshot_to_prometheus(snapshots[-1]), end="")
+    return 0
+
+
+# ----------------------------------------------------------------- quality
+def _cmd_quality(args) -> int:
+    from fedrec_tpu.obs.report import quality_detail_from_snapshot
+
+    metrics_path, _ = _resolve(args.path)
+    loaded = _load_event_log(metrics_path)
+    if isinstance(loaded, int):
+        return loaded
+    _, snapshots = loaded
+    if not snapshots:
+        return _fail(
+            f"no registry snapshot in {metrics_path} (the run may have "
+            "died before its first obs.snapshot_every round)"
+        )
+    detail = quality_detail_from_snapshot(snapshots[-1])
+    if not detail:
+        return _fail(
+            f"no quality telemetry in {metrics_path} — was the run "
+            "started with obs.quality.enabled=1 (sliced eval; on "
+            "fedrec-serve it also arms the drift probe, "
+            "obs.quality.probe_users)?"
+        )
+    if args.json:
+        print(json.dumps(detail, indent=2))
+        return 0
+    lines = ["# fedrec_tpu quality report", ""]
+    slices = detail.get("slices")
+    if slices:
+        lines.append("## Eval slices (last eval, ascending AUC)")
+        lines.append(
+            f"{'slice':<20} {'auc':>8} {'mrr':>8} {'ndcg5':>8} "
+            f"{'ndcg10':>8} {'count':>7}"
+        )
+        ordered = sorted(
+            slices.items(), key=lambda kv: kv[1].get("auc", float("inf"))
+        )
+        for name, m in ordered:
+            lines.append(
+                f"{name:<20} {m.get('auc', float('nan')):>8.4f} "
+                f"{m.get('mrr', float('nan')):>8.4f} "
+                f"{m.get('ndcg5', float('nan')):>8.4f} "
+                f"{m.get('ndcg10', float('nan')):>8.4f} "
+                f"{int(m.get('count', 0)):>7}"
+            )
+        if detail.get("slices_skipped"):
+            lines.append(
+                f"(+ {int(detail['slices_skipped'])} slice evaluations "
+                "skipped: empty/degenerate strata)"
+            )
+        lines.append("")
+    if "ece" in detail or "score_separation" in detail:
+        lines.append("## Scores & calibration")
+        if "score_separation" in detail:
+            dp = (
+                f", d'={detail['score_dprime']:.3f}"
+                if "score_dprime" in detail else ""
+            )
+            lines.append(
+                f"separation: {detail['score_separation']:.4f}{dp}"
+            )
+        if "ece" in detail:
+            lines.append(f"ece: {detail['ece']:.4f}")
+        for row in detail.get("calibration", []):
+            if row.get("count"):
+                lines.append(
+                    f"  bin {row['bin']}: conf="
+                    f"{row.get('confidence', float('nan')):.3f} "
+                    f"acc={row.get('accuracy', float('nan')):.3f} "
+                    f"n={int(row['count'])}"
+                )
+        lines.append("")
+    if "client_auc" in detail:
+        lines.append("## Per-client AUC")
+        lines.append(", ".join(
+            f"c{c}={v:.4f}" for c, v in detail["client_auc"].items()
+        ))
+        if detail.get("quality_outlier_client_evals"):
+            lines.append(
+                "quality-outlier client-evals: "
+                f"{int(detail['quality_outlier_client_evals'])}"
+            )
+        lines.append("")
+    drift = detail.get("drift")
+    if drift:
+        lines.append("## Serving drift (last pre-swap probe)")
+        if "score_shift_mean" in drift:
+            lines.append(
+                f"|Δscore| mean={drift['score_shift_mean']:.4g} "
+                f"max={drift.get('score_shift_max', 0):.4g}"
+            )
+        if "topk_jaccard" in drift:
+            lines.append(
+                f"top-k jaccard={drift['topk_jaccard']:.3f} "
+                f"(churn {drift.get('rank_churn', 0):.3f}) over "
+                f"{int(drift.get('checks', 0))} check(s)"
+            )
+        lines.append("")
+    print("\n".join(lines))
     return 0
 
 
@@ -403,6 +512,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     prom.add_argument("path", help="obs dir or metrics.jsonl path")
     prom.set_defaults(fn=_cmd_prom)
+    qu = sub.add_parser(
+        "quality",
+        help="model-quality report: per-slice eval metrics, calibration, "
+             "per-client AUC, serving drift (obs.quality telemetry)",
+    )
+    qu.add_argument("path", help="obs dir or metrics.jsonl path")
+    qu.add_argument("--json", action="store_true",
+                    help="machine-readable detail instead of text")
+    qu.set_defaults(fn=_cmd_quality)
     rp = sub.add_parser(
         "replay",
         help="re-execute a flight-recorder dump on CPU to confirm/bisect",

@@ -171,6 +171,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     if service is None:
         return 2
+    if cfg.obs.quality.enabled and cfg.obs.quality.probe_users > 0:
+        # pre-swap drift probe: every {"cmd":"refresh"} hot-swap scores
+        # the pinned probe set against both generations first, so a bad
+        # table push surfaces serve.drift_* before it serves traffic
+        service.store.enable_drift_probe(
+            num_probes=cfg.obs.quality.probe_users,
+            topk=cfg.obs.quality.probe_topk,
+            seed=cfg.obs.quality.seed,
+        )
     service.warmup()  # compile every bucket before accepting traffic
     import os as _os
 

@@ -55,6 +55,10 @@ class IndexedSamples:
     neg_lens: np.ndarray      # (N,) int32
     history: np.ndarray       # (N, max_his_len) int32
     his_len: np.ndarray       # (N,) int32
+    # user index per sample (the reference record's uidx field) — carried
+    # for user-level telemetry (activity slices in obs.quality); None for
+    # pre-existing callers that build the arrays directly
+    uidx: np.ndarray | None = None
 
     def __len__(self) -> int:
         return self.pos.shape[0]
@@ -67,6 +71,7 @@ class IndexedSamples:
             neg_lens=self.neg_lens[idx],
             history=self.history[idx],
             his_len=self.his_len[idx],
+            uidx=None if self.uidx is None else self.uidx[idx],
         )
 
 
@@ -80,7 +85,9 @@ def index_samples(samples: list, nid2index: dict, max_his_len: int) -> IndexedSa
     neg_lens = np.zeros(n, dtype=np.int32)
     history = np.zeros((n, max_his_len), dtype=np.int32)
     his_len = np.zeros(n, dtype=np.int32)
-    for i, (_, p, negs, his, _) in enumerate(samples):
+    uidx = np.zeros(n, dtype=np.int64)
+    for i, (u, p, negs, his, _) in enumerate(samples):
+        uidx[i] = int(u)
         pos[i] = nid2index[p]
         neg_idx = [nid2index[x] for x in negs]
         neg_pools[i, : len(neg_idx)] = neg_idx
@@ -88,7 +95,7 @@ def index_samples(samples: list, nid2index: dict, max_his_len: int) -> IndexedSa
         his_idx = [nid2index[x] for x in his][-max_his_len:]  # keep most recent
         history[i, : len(his_idx)] = his_idx
         his_len[i] = len(his_idx)
-    return IndexedSamples(pos, neg_pools, neg_lens, history, his_len)
+    return IndexedSamples(pos, neg_pools, neg_lens, history, his_len, uidx=uidx)
 
 
 def shard_indices(

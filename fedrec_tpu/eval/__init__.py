@@ -6,7 +6,9 @@ from fedrec_tpu.eval.metrics import (
     mrr_score,
     ndcg_score,
     full_pool_metrics_batch,
+    quality_stats_batch,
     ranking_metrics_batch,
+    safe_auc_score,
 )
 
 __all__ = [
@@ -17,5 +19,7 @@ __all__ = [
     "mrr_score",
     "ndcg_score",
     "full_pool_metrics_batch",
+    "quality_stats_batch",
     "ranking_metrics_batch",
+    "safe_auc_score",
 ]

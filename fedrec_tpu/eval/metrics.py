@@ -90,6 +90,26 @@ def auc_score(y_true: np.ndarray, y_score: np.ndarray) -> float:
     return u / (n_pos * n_neg)
 
 
+def safe_auc_score(y_true: np.ndarray, y_score: np.ndarray) -> float:
+    """Degenerate-safe :func:`auc_score`: NaN instead of ValueError when
+    the label set holds only one class.
+
+    The host-side variant for sliced/stratified analysis: a stratum that
+    happens to be all-positive (or all-negative) has no defined AUC, and
+    ``auc_score``'s raise would abort a whole sliced pass — NaN lets the
+    caller skip that stratum and keep the rest.  (The in-graph sliced
+    eval never hits this case — its per-impression closed forms always
+    see 1 positive + the real negatives, and empty strata are skipped by
+    count, ``eval.slices_skipped_total``.)  ``auc_score`` itself keeps
+    raising — ``evaluation_split``'s try/except skip is reference
+    parity.
+    """
+    y_true = np.asarray(y_true, dtype=np.float64)
+    if np.sum(y_true == 1) == 0 or np.sum(y_true == 0) == 0:
+        return float("nan")
+    return auc_score(y_true, y_score)
+
+
 def compute_amn(y_true: np.ndarray, y_score: np.ndarray) -> tuple[float, float, float, float]:
     """(AUC, MRR, NDCG@5, NDCG@10) — reference ``evaluation_functions.py:26-31``."""
     return (
@@ -207,3 +227,100 @@ def full_pool_metrics_batch(
     rank = 1.0 + beaten_by
     auc = jnp.where(n_neg > 0, (n_neg - beaten_by) / jnp.maximum(n_neg, 1.0), 0.0)
     return {"auc": auc, **_metrics_from_rank(rank)}
+
+
+# --------------------------------------------------------------------------
+# device-side quality stats: fixed-shape score histograms + reliability bins
+# --------------------------------------------------------------------------
+
+# every key quality_stats_batch returns — the step builders key their
+# sharding specs off this, the host accumulator its sums
+QUALITY_SUM_KEYS = (
+    "q.pos_hist", "q.neg_hist",
+    "q.pos_sum", "q.pos_sq", "q.pos_n",
+    "q.neg_sum", "q.neg_sq", "q.neg_n",
+    "q.cal_n", "q.cal_conf", "q.cal_label",
+)
+
+
+def _fixed_bin_counts(
+    values: jnp.ndarray, weights: jnp.ndarray, lo: float, hi: float, bins: int
+) -> jnp.ndarray:
+    """Weighted fixed-bin histogram counts, fully in-graph.
+
+    ``bins`` equal-width buckets over [lo, hi); out-of-range values clamp
+    to the edge bins (a score histogram must never lose mass to an
+    unlucky range guess).  One-hot matmul keeps every shape static — no
+    host sync, no data-dependent shapes."""
+    import jax
+
+    width = (hi - lo) / bins
+    idx = jnp.clip(jnp.floor((values - lo) / width), 0, bins - 1).astype(jnp.int32)
+    # NaN scores floor to index 0 via clip-of-NaN -> 0 after astype; mask
+    # them out entirely instead (a non-finite score is the sentry's
+    # problem, not a histogram bin)
+    w = jnp.where(jnp.isfinite(values), weights, 0.0)
+    onehot = jax.nn.one_hot(idx, bins, dtype=jnp.float32)
+    return jnp.einsum("...b,...->b", onehot, w.astype(jnp.float32))
+
+
+def quality_stats_batch(
+    pos_scores: jnp.ndarray,
+    neg_scores: jnp.ndarray,
+    neg_mask: jnp.ndarray,
+    keep: jnp.ndarray,
+    score_bins: int,
+    score_range: float,
+    ece_bins: int,
+) -> dict:
+    """Score-distribution + calibration partial sums for one eval batch.
+
+    All outputs are FIXED-shape reductions (no data-dependent shapes, no
+    host syncs) so the jitted full-pool eval pass can return them next to
+    its per-impression metrics:
+
+      * ``q.pos_hist`` / ``q.neg_hist``: (score_bins,) weighted counts of
+        positive / real-negative scores over
+        ``[-score_range, +score_range]`` (edge bins absorb outliers);
+      * ``q.pos_sum`` / ``q.pos_sq`` / ``q.pos_n`` (and ``neg_``
+        equivalents): moments for separation stats;
+      * ``q.cal_n`` / ``q.cal_conf`` / ``q.cal_label``: (ece_bins,)
+        reliability-table partial sums over ``sigmoid(score)`` with label
+        1 for positives, 0 for negatives — ECE is a closed form of these.
+
+    ``keep`` (B,) zeroes padded impressions; ``neg_mask`` (B, P) zeroes
+    pool padding. Pinned hand-exact against a numpy reference in
+    ``tests/test_quality.py``.
+    """
+    pos = jnp.asarray(pos_scores)
+    neg = jnp.asarray(neg_scores)
+    keep = jnp.asarray(keep, jnp.float32)
+    nw = jnp.asarray(neg_mask, jnp.float32) * keep[:, None]
+
+    out = {
+        "q.pos_hist": _fixed_bin_counts(
+            pos, keep, -score_range, score_range, score_bins
+        ),
+        "q.neg_hist": _fixed_bin_counts(
+            neg, nw, -score_range, score_range, score_bins
+        ),
+        "q.pos_sum": jnp.sum(pos * keep),
+        "q.pos_sq": jnp.sum(pos * pos * keep),
+        "q.pos_n": jnp.sum(keep),
+        "q.neg_sum": jnp.sum(neg * nw),
+        "q.neg_sq": jnp.sum(neg * neg * nw),
+        "q.neg_n": jnp.sum(nw),
+    }
+    # reliability bins over predicted click probability sigmoid(s):
+    # bin b covers [b/B, (b+1)/B); prob 1.0 clamps into the last bin
+    prob_pos = 1.0 / (1.0 + jnp.exp(-pos))
+    prob_neg = 1.0 / (1.0 + jnp.exp(-neg))
+    out["q.cal_n"] = _fixed_bin_counts(prob_pos, keep, 0.0, 1.0, ece_bins) + \
+        _fixed_bin_counts(prob_neg, nw, 0.0, 1.0, ece_bins)
+    out["q.cal_conf"] = _fixed_bin_counts(
+        prob_pos, prob_pos * keep, 0.0, 1.0, ece_bins
+    ) + _fixed_bin_counts(prob_neg, prob_neg * nw, 0.0, 1.0, ece_bins)
+    # labels: positives contribute 1 per impression, negatives 0 — the
+    # label sum is just the positives' bin counts
+    out["q.cal_label"] = _fixed_bin_counts(prob_pos, keep, 0.0, 1.0, ece_bins)
+    return out

@@ -21,6 +21,12 @@ Three pieces, one namespace:
   forensics on non-finite/divergence triggers (``fedrec-obs replay``).
 * :mod:`fedrec_tpu.obs.device` — device-layer watchdogs: XLA recompile
   accounting with shape provenance and round-boundary HBM gauges.
+* :mod:`fedrec_tpu.obs.quality` — model-quality observability: fixed
+  seeded eval slices + per-slice ranking-metric gauges, score/calibration
+  digests (ECE) off the jitted eval pass, per-client quality-outlier
+  digests, and the serving store's pre-swap drift probe
+  (``serve.drift_*``); the banked regression gate is
+  ``benchmarks/quality_gate.py``.
 * :mod:`fedrec_tpu.obs.fleet` — fleet-wide observability: worker/rank/
   membership-epoch correlation keys on every span and JSONL record, a
   round-cadence telemetry collector with an offline ``worker_*`` merge
@@ -67,6 +73,12 @@ from fedrec_tpu.obs.health import (
     HealthMonitor,
     TrainingHealthError,
 )
+from fedrec_tpu.obs.quality import (
+    DriftProbe,
+    QualityMonitor,
+    SlicedEvalAccumulator,
+    build_slice_defs,
+)
 from fedrec_tpu.obs.device import (
     CompileWatchdog,
     sample_device_memory,
@@ -77,16 +89,20 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "CompileWatchdog",
     "Counter",
+    "DriftProbe",
     "FleetPusher",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "QualityMonitor",
+    "SlicedEvalAccumulator",
     "TelemetryCollector",
     "Tracer",
     "TrainingHealthError",
     "build_report",
+    "build_slice_defs",
     "dump_artifacts",
     "ensure_fleet_identity",
     "get_fleet_identity",

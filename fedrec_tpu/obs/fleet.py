@@ -940,6 +940,47 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
                 dcn[wid]["bytes_down"] = down
     if dcn:
         report["dcn_bytes"] = dcn
+
+    # ---- quality (obs.quality): per-worker corpus AUC, the worst eval
+    # slice, calibration and serving drift — the fleet view of the sliced
+    # eval telemetry, compacted from the ONE shared extraction
+    # (report.quality_detail_from_snapshot). Silent when no worker
+    # published quality gauges.
+    from fedrec_tpu.obs.report import quality_detail_from_snapshot
+
+    quality: dict[str, Any] = {}
+    for wid in sorted(workers):
+        snap = workers[wid].last_snapshot()
+        if snap is None:
+            continue
+        detail = quality_detail_from_snapshot(snap)
+        if not detail:
+            continue
+        qw: dict[str, Any] = {}
+        slices_d = {
+            k: m for k, m in detail.get("slices", {}).items() if "auc" in m
+        }
+        if "all" in slices_d:
+            qw["auc"] = slices_d["all"]["auc"]
+        named = {k: m["auc"] for k, m in slices_d.items() if k != "all"}
+        if named:
+            worst = min(named, key=named.get)
+            qw["worst_slice"] = worst
+            qw["worst_slice_auc"] = named[worst]
+        for key in ("ece", "quality_outlier_client_evals"):
+            if key in detail:
+                qw[key] = detail[key]
+        drift = detail.get("drift", {})
+        for key, src in (
+            ("drift_rank_churn", "rank_churn"),
+            ("drift_score_shift_mean", "score_shift_mean"),
+        ):
+            if src in drift:
+                qw[key] = drift[src]
+        if qw:
+            quality[wid] = qw
+    if quality:
+        report["quality"] = quality
     return report
 
 
@@ -1020,6 +1061,29 @@ def render_fleet_text(report: dict) -> str:
                 f"{p}={_mb(v)}" for p, v in sorted(d["bytes_up"].items())
             )
             lines.append(f"worker {wid}: up {up}")
+        lines.append("")
+    quality = report.get("quality")
+    if quality:
+        lines.append("## Quality by worker")
+        for wid, qw in quality.items():
+            parts = []
+            if "auc" in qw:
+                parts.append(f"auc={qw['auc']:.4f}")
+            if "worst_slice" in qw:
+                parts.append(
+                    f"worst slice {qw['worst_slice']}="
+                    f"{qw['worst_slice_auc']:.4f}"
+                )
+            if "ece" in qw:
+                parts.append(f"ece={qw['ece']:.4f}")
+            if "drift_rank_churn" in qw:
+                parts.append(f"drift churn={qw['drift_rank_churn']:.3f}")
+            if "quality_outlier_client_evals" in qw:
+                parts.append(
+                    f"outlier client-evals="
+                    f"{int(qw['quality_outlier_client_evals'])}"
+                )
+            lines.append(f"worker {wid}: " + ", ".join(parts))
         lines.append("")
     if not report.get("workers"):
         lines.append("(no workers found)")

@@ -121,6 +121,12 @@ class HealthMonitor:
         # is not a dump trigger, but under fed.robust.recover it is a
         # quarantine trigger
         self.last_outliers: list[dict] = []
+        # quality-outlier clients from the obs.quality per-client digest
+        # (eval cadence): published here so triage tooling reads norm- AND
+        # quality-flags off one monitor. Informational by contract — the
+        # recovery path keys on update norms only, a quality dip NEVER
+        # quarantines (fedrec_tpu.obs.quality.QualityMonitor.digest_clients)
+        self.last_quality_outliers: list[dict] = []
 
     # ------------------------------------------------------------ publish
     def publish_clip_rate(self, clip_rates: np.ndarray) -> None:

@@ -153,6 +153,75 @@ def quantile_is_lower_bound(row: dict, q: float) -> bool:
     return q * total > total - inf_count
 
 
+def quality_detail_from_snapshot(snap: dict) -> dict:
+    """The full quality digest out of one registry snapshot — every slice's
+    metric quartet + impression count, the reliability table, score
+    separation, and the serving drift verdict.  The ``fedrec-obs quality``
+    verb renders this; ``build_report``'s Quality section is the compact
+    subset."""
+    detail: dict[str, Any] = {}
+    slices: dict[str, dict] = {}
+    for metric in ("auc", "mrr", "ndcg5", "ndcg10"):
+        for row in _metric_values(snap, f"eval.{metric}"):
+            if "value" in row:
+                slices.setdefault(
+                    row["labels"].get("slice", "?"), {}
+                )[metric] = row["value"]
+    for row in _metric_values(snap, "eval.slice_impressions"):
+        if "value" in row:
+            name = row["labels"].get("slice", "?")
+            if name in slices:
+                slices[name]["count"] = row["value"]
+    if slices:
+        detail["slices"] = dict(sorted(slices.items()))
+    cal: dict[int, dict] = {}
+    for key, name in (
+        ("count", "eval.calibration_count"),
+        ("confidence", "eval.calibration_confidence"),
+        ("accuracy", "eval.calibration_accuracy"),
+    ):
+        for row in _metric_values(snap, name):
+            if "value" in row:
+                cal.setdefault(int(row["labels"].get("bin", -1)), {})[key] = (
+                    row["value"]
+                )
+    if cal:
+        detail["calibration"] = [
+            {"bin": b, **cal[b]} for b in sorted(cal)
+        ]
+    for key, name in (
+        ("ece", "eval.ece"),
+        ("score_separation", "eval.score_separation"),
+        ("score_dprime", "eval.score_dprime"),
+        ("slices_skipped", "eval.slices_skipped_total"),
+        ("quality_outlier_client_evals", "eval.quality_outlier_clients_total"),
+    ):
+        v = snapshot_value(snap, name)
+        if v is not None:
+            detail[key] = v
+    clients = {
+        row["labels"].get("client", "?"): row["value"]
+        for row in _metric_values(snap, "eval.client_auc")
+        if "value" in row
+    }
+    if clients:
+        detail["client_auc"] = dict(sorted(clients.items()))
+    drift = {
+        key: v
+        for key, name in (
+            ("score_shift_mean", "serve.drift_score_shift_mean"),
+            ("score_shift_max", "serve.drift_score_shift_max"),
+            ("topk_jaccard", "serve.drift_topk_jaccard"),
+            ("rank_churn", "serve.drift_rank_churn"),
+            ("checks", "serve.drift_checks_total"),
+        )
+        if (v := snapshot_value(snap, name)) is not None
+    }
+    if drift:
+        detail["drift"] = drift
+    return detail
+
+
 # -------------------------------------------------------------- the report
 def build_report(
     records: list[dict],
@@ -173,12 +242,22 @@ def build_report(
         }
         if len(rounds) > 1 and elapsed > 0:
             tr["rounds_per_sec"] = round((len(rounds) - 1) / elapsed, 4)
-        evals = [r for r in rounds if "valid_auc" in r]
+        # unified key scheme (val_auc/val_mrr/val_ndcg5/val_ndcg10) with a
+        # legacy fallback so pre-rename artifacts (valid_auc/val_ndcg@5)
+        # still render
+        _EVAL_KEYS = (
+            ("val_auc", "valid_auc"),
+            ("val_mrr", "valid_mrr"),
+            ("val_ndcg5", "val_ndcg@5"),
+            ("val_ndcg10", "val_ndcg@10"),
+        )
+        evals = [r for r in rounds if "val_auc" in r or "valid_auc" in r]
         if evals:
+            last_ev = evals[-1]
             tr["last_eval"] = {
-                k: evals[-1][k]
-                for k in ("valid_auc", "valid_mrr", "val_ndcg@5", "val_ndcg@10")
-                if k in evals[-1]
+                new: (last_ev[new] if new in last_ev else last_ev[old])
+                for new, old in _EVAL_KEYS
+                if new in last_ev or old in last_ev
             }
         report["training"] = tr
 
@@ -415,6 +494,44 @@ def build_report(
                         break
             report["membership"] = mem
 
+        # ---- quality: sliced eval telemetry + calibration + serving
+        # drift (obs.quality) — the compact subset of ONE extraction
+        # (quality_detail_from_snapshot, shared with `fedrec-obs quality`
+        # and the fleet report), so the three views can never disagree;
+        # silent (empty detail) on a quality-off run
+        detail = quality_detail_from_snapshot(last)
+        if detail:
+            ql: dict[str, Any] = {}
+            slices_d = {
+                name: m for name, m in detail.get("slices", {}).items()
+                if "auc" in m
+            }
+            if slices_d:
+                ql["slices"] = {
+                    name: {
+                        "auc": m["auc"],
+                        **({"count": m["count"]} if "count" in m else {}),
+                    }
+                    for name, m in slices_d.items()
+                }
+                if "all" in slices_d:
+                    ql["corpus_auc"] = slices_d["all"]["auc"]
+                named = {
+                    k: m["auc"] for k, m in slices_d.items() if k != "all"
+                }
+                if named:
+                    ql["worst_slice"] = min(named, key=named.get)
+                    ql["best_slice"] = max(named, key=named.get)
+            for key in (
+                "ece", "score_separation", "score_dprime",
+                "quality_outlier_client_evals", "slices_skipped",
+            ):
+                if key in detail:
+                    ql[key] = detail[key]
+            if "drift" in detail:
+                ql["drift"] = detail["drift"]
+            report["quality"] = ql
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -640,6 +757,60 @@ def render_text(report: dict) -> str:
             )
             lines.append(
                 f"last epoch hand-off: {mem['reshard_seconds']:.3f}s{rows}"
+            )
+        lines.append("")
+    ql = report.get("quality")
+    if ql:
+        lines.append("## Quality")
+        slices = ql.get("slices", {})
+        if "corpus_auc" in ql:
+            n = slices.get("all", {}).get("count")
+            over = f" over {int(n)} impressions" if n is not None else ""
+            lines.append(f"corpus auc: {ql['corpus_auc']:.4f}{over}")
+        if "worst_slice" in ql:
+            w, b = ql["worst_slice"], ql["best_slice"]
+            n_named = len(slices) - (1 if "all" in slices else 0)
+            lines.append(
+                f"slices: {n_named} — worst {w} "
+                f"auc={slices[w]['auc']:.4f}, best {b} "
+                f"auc={slices[b]['auc']:.4f}"
+            )
+        if "slices_skipped" in ql and ql["slices_skipped"]:
+            lines.append(
+                f"slices skipped (empty/degenerate): "
+                f"{int(ql['slices_skipped'])}"
+            )
+        if "ece" in ql:
+            lines.append(f"calibration: ece={ql['ece']:.4f}")
+        if "score_separation" in ql:
+            dp = (
+                f" (d'={ql['score_dprime']:.3f})"
+                if "score_dprime" in ql else ""
+            )
+            lines.append(
+                f"score separation: {ql['score_separation']:.4f}{dp}"
+            )
+        if "quality_outlier_client_evals" in ql:
+            lines.append(
+                "quality-outlier client-evals: "
+                f"{int(ql['quality_outlier_client_evals'])}"
+            )
+        dr = ql.get("drift")
+        if dr:
+            parts = []
+            if "score_shift_mean" in dr:
+                parts.append(
+                    f"|Δscore| mean={dr['score_shift_mean']:.4g} "
+                    f"max={dr.get('score_shift_max', 0):.4g}"
+                )
+            if "topk_jaccard" in dr:
+                parts.append(
+                    f"top-k jaccard={dr['topk_jaccard']:.3f} "
+                    f"(churn {dr.get('rank_churn', 0):.3f})"
+                )
+            lines.append(
+                f"serving drift (last swap, {int(dr.get('checks', 0))} "
+                f"probe check(s)): " + ", ".join(parts)
             )
         lines.append("")
     if "cap_overflow_steps" in report:

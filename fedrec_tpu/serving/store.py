@@ -62,12 +62,13 @@ class EmbeddingStore:
     ``swap_count`` consistent if two publishers ever race.
     """
 
-    def __init__(self, clock=time.time, registry=None):
+    def __init__(self, clock=time.time, registry=None, drift_probe=None):
         self._clock = clock
         self._lock = threading.Lock()
         self._gen: Generation | None = None
         self._swap_count = 0
-        reg = registry or get_registry()
+        self._registry = registry or get_registry()
+        reg = self._registry
         self._g_generation = reg.gauge(
             "serve.generation", "embedding-store generation being served"
         )
@@ -76,6 +77,23 @@ class EmbeddingStore:
         )
         self._g_num_news = reg.gauge(
             "serve.num_news", "catalog rows in the current generation"
+        )
+        # pre-swap quality probe (obs.quality): scores a pinned probe set
+        # against the outgoing AND incoming generation BEFORE the swap, so
+        # a bad table push shows non-zero serve.drift_* metrics before it
+        # serves traffic. None = the exact pre-quality publish path.
+        self._drift = drift_probe
+
+    def enable_drift_probe(
+        self, num_probes: int = 32, topk: int = 10, seed: int = 0
+    ) -> None:
+        """Arm the pre-swap drift probe (``obs.quality.probe_users`` /
+        ``probe_topk`` in the serving CLI; tests arm it directly)."""
+        from fedrec_tpu.obs.quality import DriftProbe
+
+        self._drift = DriftProbe(
+            num_probes=num_probes, topk=topk, seed=seed,
+            registry=self._registry,
         )
 
     # ------------------------------------------------------------ readers
@@ -97,7 +115,7 @@ class EmbeddingStore:
         gen = self._gen
         if gen is None:
             return {"generation": None, "swap_count": self._swap_count}
-        return {
+        out = {
             "generation": gen.generation,
             "swap_count": self._swap_count,
             "round": gen.round,
@@ -105,6 +123,14 @@ class EmbeddingStore:
             "num_news": gen.num_news,
             "staleness_sec": round(self._clock() - gen.published_at, 3),
         }
+        if self._drift is not None and self._drift.last is not None:
+            # the last pre-swap probe verdict rides the admin metrics dict
+            # (strict superset of the pre-quality keys)
+            out.update({
+                f"drift_{k}": v for k, v in self._drift.last.items()
+                if isinstance(v, (int, float, bool))
+            })
+        return out
 
     # ------------------------------------------------------------ writers
     def publish(
@@ -116,9 +142,22 @@ class EmbeddingStore:
         source: str = "manual",
     ) -> Generation:
         """Build the full new generation, then swap it in atomically.
-        The first publish is generation 0 and does not count as a swap."""
+        The first publish is generation 0 and does not count as a swap.
+        With a drift probe armed, the incoming table is scored against
+        the outgoing one BEFORE the swap (serve.drift_* metrics) — a
+        probe failure is reported, never allowed to block the publish."""
         with self._lock:
             prev = self._gen
+            if self._drift is not None and prev is not None:
+                try:
+                    self._drift.compare(
+                        np.asarray(prev.news_vecs), prev.valid_mask,
+                        np.asarray(news_vecs), valid_mask,
+                    )
+                except Exception as e:  # noqa: BLE001 — the probe is telemetry;
+                    # a malformed table must still reach the swap's own
+                    # validation rather than dying in the probe
+                    print(f"[store] drift probe failed: {type(e).__name__}: {e}")
             gen = Generation(
                 generation=0 if prev is None else prev.generation + 1,
                 news_vecs=news_vecs,

@@ -1582,11 +1582,21 @@ def build_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
     return jax.jit(evaluate)
 
 
-def _full_eval_body(model: NewsRecommender) -> Callable:
+def _full_eval_body(
+    model: NewsRecommender, quality: tuple | None = None
+) -> Callable:
     """Per-impression full-pool scoring — the ONE definition both the
     unsharded and the mesh-sharded eval step wrap (a fix applied to the
-    scoring math can never diverge the two paths)."""
-    from fedrec_tpu.eval.metrics import full_pool_metrics_batch
+    scoring math can never diverge the two paths).
+
+    ``quality`` = ``(score_bins, score_range, ece_bins)`` additionally
+    returns the fixed-shape quality partial sums
+    (:func:`fedrec_tpu.eval.metrics.quality_stats_batch` — score
+    histograms + reliability bins, no host syncs) from the SAME scores;
+    the batch then carries a ``keep`` (B,) weight vector zeroing padded
+    impressions.  ``quality=None`` builds the exact pre-quality program.
+    """
+    from fedrec_tpu.eval.metrics import full_pool_metrics_batch, quality_stats_batch
 
     def evaluate(user_params, news_vecs, batch):
         his_vecs = news_vecs[batch["history"]]
@@ -1597,12 +1607,21 @@ def _full_eval_body(model: NewsRecommender) -> Callable:
         )  # (B, D)
         pos_scores = jnp.einsum("bd,bd->b", news_vecs[batch["pos"]], user_vec)
         neg_scores = jnp.einsum("bpd,bd->bp", news_vecs[batch["neg_pools"]], user_vec)
-        return full_pool_metrics_batch(pos_scores, neg_scores, batch["neg_mask"])
+        out = full_pool_metrics_batch(pos_scores, neg_scores, batch["neg_mask"])
+        if quality is not None:
+            score_bins, score_range, ece_bins = quality
+            out.update(quality_stats_batch(
+                pos_scores, neg_scores, batch["neg_mask"], batch["keep"],
+                score_bins, score_range, ece_bins,
+            ))
+        return out
 
     return evaluate
 
 
-def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Callable:
+def build_full_eval_step(
+    model: NewsRecommender, cfg: ExperimentConfig, quality: tuple | None = None
+) -> Callable:
     """Deterministic FULL-POOL evaluation step.
 
     ``evaluate(user_params, news_vecs_table, batch) -> dict of (B,) arrays``
@@ -1611,12 +1630,15 @@ def build_full_eval_step(model: NewsRecommender, cfg: ExperimentConfig) -> Calla
     Scores every real pool negative against the one positive — the protocol
     behind the reference's published MIND table (``evaluation_split``,
     reference ``evaluation_functions.py:33-47``), with no sampling noise.
+    ``quality`` (see :func:`_full_eval_body`) adds the fixed-shape
+    quality partial sums to the outputs.
     """
-    return jax.jit(_full_eval_body(model))
+    return jax.jit(_full_eval_body(model, quality))
 
 
 def build_full_eval_step_sharded(
-    model: NewsRecommender, cfg: ExperimentConfig, mesh: Mesh
+    model: NewsRecommender, cfg: ExperimentConfig, mesh: Mesh,
+    quality: tuple | None = None,
 ) -> Callable:
     """:func:`build_full_eval_step` sharded over EVERY mesh axis.
 
@@ -1628,13 +1650,42 @@ def build_full_eval_step_sharded(
     bottleneck at MIND scale — takes ``1/mesh.size`` of the wall time.
     Callers must keep the batch axis divisible by ``mesh.size`` (the
     Trainer rounds its eval block size accordingly).
+
+    With ``quality`` set, the per-shard quality partial sums are
+    ``psum``-reduced across the mesh inside the shard body and come back
+    replicated (out-spec ``P()``), so the host accumulates the same
+    global sums it would from the unsharded step.
     """
     axes = tuple(mesh.axis_names)
+    if quality is None:
+        sharded = partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axes)),
+            out_specs=P(axes),
+            check_vma=False,
+        )(_full_eval_body(model))
+        return jax.jit(sharded)
+
+    from fedrec_tpu.eval.metrics import QUALITY_SUM_KEYS
+
+    body = _full_eval_body(model, quality)
+
+    def body_psum(user_params, news_vecs, batch):
+        out = body(user_params, news_vecs, batch)
+        for k in QUALITY_SUM_KEYS:
+            out[k] = jax.lax.psum(out[k], axes)
+        return out
+
+    out_specs = {
+        **{k: P(axes) for k in ("auc", "mrr", "ndcg5", "ndcg10")},
+        **{k: P() for k in QUALITY_SUM_KEYS},
+    }
     sharded = partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(axes)),
-        out_specs=P(axes),
+        out_specs=out_specs,
         check_vma=False,
-    )(_full_eval_body(model))
+    )(body_psum)
     return jax.jit(sharded)

@@ -457,6 +457,24 @@ class Trainer:
             if self.mesh.size > 1
             else build_full_eval_step(self.model, cfg)
         )
+        # quality-instrumented twin (obs.quality.enabled): same scoring
+        # math plus fixed-shape score/calibration partial sums. A separate
+        # compiled program so the DISABLED path keeps the exact pre-quality
+        # program (byte-identical trajectories, tests/test_quality.py).
+        self.full_eval_step_q = None
+        if cfg.obs.quality.enabled:
+            qspec = (
+                int(cfg.obs.quality.score_bins),
+                float(cfg.obs.quality.score_range),
+                int(cfg.obs.quality.ece_bins),
+            )
+            self.full_eval_step_q = (
+                build_full_eval_step_sharded(
+                    self.model, cfg, self.mesh, quality=qspec
+                )
+                if self.mesh.size > 1
+                else build_full_eval_step(self.model, cfg, quality=qspec)
+            )
 
         # state (pre-sharded so the first step doesn't retrace)
         state0 = init_client_state(
@@ -929,6 +947,17 @@ class Trainer:
         # non-finite trigger dumps a replayable forensic bundle.
         hcfg = cfg.obs.health
         self.health = HealthMonitor(hcfg, registry=self.registry)
+        # ---- model-quality observability (fedrec_tpu.obs.quality): the
+        # sliced-eval publisher + per-client quality digest. Slice
+        # definitions are built lazily at the first eval (valid_ix is
+        # fixed for the run) and reused by every eval and the banked
+        # quality gate.
+        self.quality = None
+        self._slice_defs = None
+        if cfg.obs.quality.enabled:
+            from fedrec_tpu.obs.quality import QualityMonitor
+
+            self.quality = QualityMonitor(cfg.obs.quality, registry=self.registry)
         self.flightrec: FlightRecorder | None = None
         if self._obs_dir is not None and hcfg.flight_recorder:
             self.flightrec = FlightRecorder(
@@ -954,6 +983,10 @@ class Trainer:
         self.full_eval_step = self.watchdog.watch(
             self.full_eval_step, "full_eval_step"
         )
+        if self.full_eval_step_q is not None:
+            self.full_eval_step_q = self.watchdog.watch(
+                self.full_eval_step_q, "full_eval_step_q"
+            )
         self.param_sync = self.watchdog.watch(self.param_sync, "param_sync")
 
         self._table: jnp.ndarray | None = None  # decoupled-mode news-vec table
@@ -2293,12 +2326,23 @@ class Trainer:
         with self.tracer.span(
             "eval", round=result.round_idx, protocol=protocol
         ):
+            # sliced-eval telemetry rides the full-pool protocols only —
+            # the sampled protocol re-draws negatives per epoch, so its
+            # per-slice numbers would carry sampling noise the banked
+            # quality gate could never threshold against
+            q = None
+            if self.quality is not None and protocol in ("full", "last4"):
+                q = self._begin_quality_eval()
             if protocol == "full":
-                result.val_metrics = self.evaluate_full()
+                result.val_metrics = self.evaluate_full(_quality=q)
             elif protocol == "last4":
-                result.val_metrics = self.evaluate_full(last_k=4)
+                result.val_metrics = self.evaluate_full(last_k=4, _quality=q)
             else:
                 result.val_metrics = self.evaluate()
+            if q is not None:
+                self._finish_quality_eval(
+                    result.round_idx, q, result.val_metrics
+                )
 
     # ----------------------------------------------------- rounds-in-jit
     def _round_is_boundary(self, round_idx: int) -> bool:
@@ -2529,7 +2573,10 @@ class Trainer:
         return {k: v / count for k, v in sums.items()}
 
     def evaluate_full(
-        self, last_k: int | None = None, client: int | None = None
+        self,
+        last_k: int | None = None,
+        client: int | None = None,
+        _quality: dict | None = None,
     ) -> dict[str, float]:
         """Deterministic evaluation over each impression's FULL negative pool.
 
@@ -2545,11 +2592,23 @@ class Trainer:
         Impressions with an empty (post-slice) pool are skipped, as the
         reference's try/except does. One compile: static (B, P) shapes with
         padding masked out of every mean.
+
+        ``_quality`` (``_begin_quality_eval``'s session dict) routes the
+        pass through the quality-instrumented eval step and folds each
+        batch's per-impression metrics into the slice accumulator and the
+        score/calibration sums.  Diverged cohorts accumulate EVERY
+        client's pass into the one session — each client scores the same
+        impression set, so pooling equals the mean-of-means the corpus
+        metric reports.  ``None`` (the default, and always when
+        ``obs.quality.enabled=false``) runs the pre-quality program
+        untouched.
         """
         assert self.valid_ix is not None, "no validation samples"
         if client is None:
             return self._aggregate_eval(
-                lambda c: self.evaluate_full(last_k=last_k, client=c)
+                lambda c: self.evaluate_full(
+                    last_k=last_k, client=c, _quality=_quality
+                )
             )
         user_params, news_params = self._client_params(client)
         table = self._corpus_for(news_params, client)
@@ -2585,27 +2644,98 @@ class Trainer:
         if pad:
             keep_a[n:] = 0.0  # padded rows never count
 
+        step = self.full_eval_step if _quality is None else self.full_eval_step_q
+        if _quality is not None:
+            # one pass per evaluated client: _finish_quality_eval divides
+            # the pooled counts back down so published impression counts
+            # stay per-validation-set (the n the noise threshold is quoted
+            # against), not ×clients on a diverged cohort
+            _quality["passes"] = _quality.get("passes", 0) + 1
         sums = {k: 0.0 for k in ("auc", "mrr", "ndcg5", "ndcg10")}
         kept = 0.0
         for b in range(0, n + pad, bsz):
             sl = slice(b, b + bsz)
-            out = self.full_eval_step(
-                user_params,
-                table,
-                {
-                    "pos": pos_a[sl],
-                    "neg_pools": pools_a[sl],
-                    "neg_mask": mask_a[sl],
-                    "history": his_a[sl],
-                },
-            )
+            batch = {
+                "pos": pos_a[sl],
+                "neg_pools": pools_a[sl],
+                "neg_mask": mask_a[sl],
+                "history": his_a[sl],
+            }
+            if _quality is not None:
+                batch["keep"] = keep_a[sl]
+            out = step(user_params, table, batch)
             w = keep_a[sl]
             for k in sums:
                 sums[k] += float(jnp.sum(out[k] * w))
             kept += float(w.sum())
+            if _quality is not None:
+                from fedrec_tpu.eval.metrics import QUALITY_SUM_KEYS
+
+                _quality["acc"].add(
+                    b, {k: np.asarray(out[k]) for k in sums}, np.asarray(w)
+                )
+                qs = _quality["sums"]
+                for k in QUALITY_SUM_KEYS:
+                    qs[k] = qs.get(k, 0.0) + np.asarray(out[k], np.float64)
         if kept == 0:
             raise ValueError("no impression has a non-empty negative pool")
         return {k: v / kept for k, v in sums.items()}
+
+    # ------------------------------------------------------- quality layer
+    def _begin_quality_eval(self) -> dict:
+        """One sliced-eval session: the slice accumulator (definitions
+        built once per run — fixed, seeded) plus the score/calibration
+        partial-sum dict the eval loop folds batches into."""
+        from fedrec_tpu.obs.quality import (
+            SlicedEvalAccumulator,
+            build_slice_defs,
+        )
+
+        if self._slice_defs is None:
+            self._slice_defs = build_slice_defs(
+                self.valid_ix, self.cfg.obs.quality
+            )
+        return {
+            "acc": SlicedEvalAccumulator(self._slice_defs, len(self.valid_ix)),
+            "sums": {},
+        }
+
+    def _finish_quality_eval(
+        self, round_idx: int, q: dict, val_metrics: dict[str, float]
+    ) -> None:
+        """Publish the session: per-slice gauges (+ skip counter), the
+        corpus quartet under ``slice="all"``, the score/calibration
+        digest, and the per-client quality-outlier digest (informational —
+        composes with quarantine's ignore set, never triggers it)."""
+        slices, skipped = q["acc"].finalize()
+        # a diverged cohort pooled every client's pass into the session:
+        # the weighted MEANS are invariant (each pass covers the same
+        # impression set), but the raw counts/sums are ×passes — scale
+        # them back so every published n means validation impressions
+        passes = max(int(q.get("passes", 1)), 1)
+        if passes > 1:
+            for m in slices.values():
+                m["count"] /= passes
+            q["sums"] = {k: v / passes for k, v in q["sums"].items()}
+        self.quality.publish_slices(slices, skipped)
+        # the category family partitions the impression set, so its counts
+        # sum to the kept (scoreable) total — the honest n for slice="all"
+        kept = sum(
+            m["count"] for n, m in slices.items() if n.startswith("category=")
+        ) or float(len(self.valid_ix))
+        self.quality.publish_corpus(val_metrics, count=kept)
+        if q["sums"]:
+            self.quality.publish_distribution(q["sums"])
+        if self.cfg.obs.quality.per_client:
+            outliers = self.quality.digest_clients(
+                round_idx,
+                self.last_per_client_metrics,
+                ignore_clients=set(self._quarantine),
+                shared=val_metrics,
+            )
+            # surfaced on the HealthMonitor next to the norm-based flags
+            # (one triage surface); informational — never a trigger
+            self.health.last_quality_outliers = outliers
 
     # ------------------------------------------------------------------
     def run(self) -> list[RoundResult]:
@@ -2705,12 +2835,17 @@ class Trainer:
             self._m_eps.set(eps)
             log["privacy.epsilon_spent"] = round(eps, 6)
         if result.val_metrics:
+            # ONE key scheme (val_<metric>), Prometheus-sanitizable as-is —
+            # the historical valid_auc/valid_mrr vs val_ndcg@5 mix forced
+            # every reader to know both spellings and the '@' keys to be
+            # mangled on exposition. fedrec-obs report keeps a legacy-key
+            # fallback so pre-rename artifacts still render.
             named = {
                 "validation_loss": result.val_metrics.get("loss"),
-                "valid_auc": result.val_metrics.get("auc"),
-                "valid_mrr": result.val_metrics.get("mrr"),
-                "val_ndcg@5": result.val_metrics.get("ndcg5"),
-                "val_ndcg@10": result.val_metrics.get("ndcg10"),
+                "val_auc": result.val_metrics.get("auc"),
+                "val_mrr": result.val_metrics.get("mrr"),
+                "val_ndcg5": result.val_metrics.get("ndcg5"),
+                "val_ndcg10": result.val_metrics.get("ndcg10"),
             }
             # the full-pool protocols have no loss key — omit, don't
             # log null

@@ -104,7 +104,7 @@ class MetricLogger:
             with open(self._jsonl_path, "a") as f:
                 f.write(line + "\n")
         # registry backend: the logged schema doubles as gauges, so snapshots
-        # and the Prometheus exposition carry training_loss/valid_auc/... too
+        # and the Prometheus exposition carry training_loss/val_auc/... too
         for k, f in numeric.items():
             try:
                 self._registry.gauge(k).set(f)

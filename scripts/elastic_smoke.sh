@@ -164,7 +164,8 @@ for line in w0.splitlines():
         except json.JSONDecodeError:
             continue
         final_rounds.add(int(rec["round"]))
-        if rec.get("auc") is not None or rec.get("valid_auc") is not None:
+        if (rec.get("auc") is not None or rec.get("val_auc") is not None
+                or rec.get("valid_auc") is not None):
             evaled = True
 assert (rounds - 1) in final_rounds, sorted(final_rounds)
 assert evaled, "the final evaluation never ran"

@@ -13,7 +13,10 @@
 #      must abort the run, the flight recorder must dump the offending
 #      batch + state + manifest + registry snapshot under
 #      obs.dir/flightrec/, and `fedrec-obs replay` must reproduce the
-#      non-finite step on CPU (exit 0 = REPRODUCED).
+#      non-finite step on CPU (exit 0 = REPRODUCED),
+#   5. the model-quality smoke (scripts/quality_smoke.sh): sliced-eval
+#      gauges + Quality report section, the store drift-probe leg, and
+#      the forced quality-gate regression failure.
 #
 #   scripts/obs_smoke.sh     # or: make obs-smoke
 #
@@ -30,7 +33,7 @@ run() {
         XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
 }
 
-echo "== [1/4] 2-round CPU training run (DP + prefetch) =="
+echo "== [1/5] 2-round CPU training run (DP + prefetch) =="
 run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --synthetic --synthetic-train 512 --synthetic-news 128 \
     --mode joint --dp-epsilon 10 \
@@ -43,14 +46,14 @@ run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --set train.eval_protocol=sampled > "$OUT/train.log" 2>&1 \
     || { tail -30 "$OUT/train.log"; exit 1; }
 
-echo "== [2/4] serve_load run =="
+echo "== [2/5] serve_load run =="
 run python benchmarks/serve_load.py --num-news 2000 --his-len 10 \
     --clients 4 --rate 50 --duration 2 --out obs_smoke_serve_load.json \
     --obs-dir "$OUT/serve" > "$OUT/serve.log" 2>&1 \
     || { tail -30 "$OUT/serve.log"; exit 1; }
 rm -f benchmarks/obs_smoke_serve_load.json
 
-echo "== [3/4] artifact assertions =="
+echo "== [3/5] artifact assertions =="
 for d in train serve; do
     for f in metrics.jsonl trace.json prometheus.txt; do
         [ -s "$OUT/$d/$f" ] || { echo "MISSING $OUT/$d/$f"; exit 1; }
@@ -113,7 +116,7 @@ assert any(e["name"] == "fed_round" and e["args"].get("worker") == "0"
 print("  fleet: 2 rounds attributed to worker 0, merged trace valid")
 EOF
 
-echo "== [4/4] forced-NaN flight-recorder round-trip =="
+echo "== [4/5] forced-NaN flight-recorder round-trip =="
 # inf lr: the first optimizer update goes non-finite, the sentry trips,
 # the run must ABORT (nonzero exit) after dumping forensics
 if run python -m fedrec_tpu.cli.run 2 16 1000 --strategy param_avg --clients 8 \
@@ -139,4 +142,7 @@ run python -m fedrec_tpu.cli.obs replay "$OUT/nan" > "$OUT/replay.log" 2>&1 \
 grep -q "REPRODUCED" "$OUT/replay.log" \
     || { echo "replay verdict missing"; tail -5 "$OUT/replay.log"; exit 1; }
 echo "  forced-NaN: abort + complete flightrec dump + replay REPRODUCED"
+
+echo "== [5/5] model-quality smoke (scripts/quality_smoke.sh) =="
+QUALITY_SMOKE_DIR="$OUT/quality" bash scripts/quality_smoke.sh
 echo "OBS_SMOKE=PASS"

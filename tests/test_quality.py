@@ -1,0 +1,514 @@
+"""Model-quality observability (fedrec_tpu.obs.quality, ISSUE-14).
+
+Pins the tentpole contracts:
+
+* slice definitions are fixed + seeded and partition the validation set;
+* the sliced/jitted metric path matches the host ``compute_amn`` path
+  per slice on random fixtures;
+* the in-graph quality stats (score histograms, reliability bins) are
+  hand-exact vs a numpy reference, and ECE is hand-exact on a
+  constructed reliability table;
+* ``safe_auc_score`` returns NaN on a single-class slice while
+  ``auc_score`` keeps raising (reference parity);
+* the drift probe is hand-exact on two hand-made store generations
+  (identical generation ⇒ zero drift) and fires through
+  ``EmbeddingStore.publish`` BEFORE the swap;
+* the degenerate config (``obs.quality.enabled=false``) leaves eval
+  metrics identical and registers no quality instruments;
+* the report/CLI surfaces render (Quality section, ``fedrec-obs
+  quality``) and the val-metric key scheme is unified with a legacy
+  fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fedrec_tpu.config import ExperimentConfig
+from fedrec_tpu.data.batcher import index_samples
+from fedrec_tpu.data.mind import make_synthetic_mind
+from fedrec_tpu.eval.metrics import (
+    QUALITY_SUM_KEYS,
+    auc_score,
+    compute_amn,
+    full_pool_metrics_batch,
+    quality_stats_batch,
+    safe_auc_score,
+)
+from fedrec_tpu.obs.quality import (
+    DriftProbe,
+    SlicedEvalAccumulator,
+    build_slice_defs,
+    category_buckets_of,
+    reduce_quality_sums,
+)
+from fedrec_tpu.obs.registry import MetricsRegistry
+
+from test_train import small_cfg, make_setup  # noqa: E402 — shared fixture
+
+
+# ---------------------------------------------------------------- slices
+def _valid_ix(num_valid=48, seed=3):
+    # his_len_range starts at 0: zero-history (cold) users must land in
+    # a hist_len slice too — the family partitions the WHOLE set
+    data = make_synthetic_mind(
+        num_news=64, num_train=16, num_valid=num_valid, title_len=12,
+        his_len_range=(0, 40), seed=seed,
+    )
+    return index_samples(data.valid_samples, data.nid2index, 50)
+
+
+def test_category_buckets_seeded_deterministic():
+    ids = np.arange(1, 501)
+    a = category_buckets_of(ids, 8, seed=0)
+    b = category_buckets_of(ids, 8, seed=0)
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 8
+    # a different seed remaps (the slices are SEEDED, not incidental)
+    c = category_buckets_of(ids, 8, seed=1)
+    assert (a != c).any()
+
+
+def test_slice_defs_partition_and_determinism():
+    ix = _valid_ix()
+    qcfg = ExperimentConfig().obs.quality
+    defs = build_slice_defs(ix, qcfg)
+    names = [d.name for d in defs]
+    assert len(set(names)) == len(names)
+    for family in ("category=", "hist_len=", "activity="):
+        fam = [d.mask for d in defs if d.name.startswith(family)]
+        assert fam, f"missing family {family}"
+        total = np.sum(fam, axis=0)
+        np.testing.assert_array_equal(total, np.ones(len(ix), dtype=total.dtype))
+    # deterministic across rebuilds
+    defs2 = build_slice_defs(ix, qcfg)
+    for d, d2 in zip(defs, defs2):
+        assert d.name == d2.name
+        np.testing.assert_array_equal(d.mask, d2.mask)
+
+
+def test_hist_edges_validation():
+    qcfg = ExperimentConfig().obs.quality
+    qcfg.hist_len_edges = "30,10"
+    with pytest.raises(ValueError, match="strictly increasing"):
+        build_slice_defs(_valid_ix(), qcfg)
+
+
+# ----------------------------------------------------- safe AUC (satellite)
+def test_safe_auc_degenerate_nan_and_parity():
+    y = np.array([1, 0, 1, 0]); s = np.array([0.9, 0.2, 0.4, 0.6])
+    assert safe_auc_score(y, s) == auc_score(y, s)
+    assert np.isnan(safe_auc_score([1, 1], [0.1, 0.2]))
+    assert np.isnan(safe_auc_score([0, 0], [0.1, 0.2]))
+    # the raising variant keeps raising — evaluation_split's try/except
+    # skip is reference parity
+    with pytest.raises(ValueError, match="AUC undefined"):
+        auc_score([1, 1], [0.1, 0.2])
+
+
+# ------------------------------------- sliced vs host compute_amn (pinned)
+def test_sliced_jitted_metrics_match_host_compute_amn():
+    """Per-slice means of the jitted per-impression closed forms equal the
+    host compute_amn path computed per impression and averaged per slice."""
+    rng = np.random.default_rng(11)
+    n, pmax = 64, 9
+    pos_scores = rng.standard_normal(n)
+    neg_scores = rng.standard_normal((n, pmax))
+    neg_lens = rng.integers(1, pmax + 1, size=n)
+    mask = (np.arange(pmax)[None, :] < neg_lens[:, None]).astype(np.float32)
+
+    out = full_pool_metrics_batch(
+        jnp.asarray(pos_scores), jnp.asarray(neg_scores), jnp.asarray(mask)
+    )
+    device = {k: np.asarray(v, np.float64) for k, v in out.items()}
+
+    # three disjoint pseudo-slices over the impressions
+    slice_ids = rng.integers(0, 3, size=n)
+    for s in range(3):
+        sel = slice_ids == s
+        host = np.array([
+            compute_amn(
+                np.array([1] + [0] * int(neg_lens[i])),
+                np.concatenate([[pos_scores[i]], neg_scores[i, : neg_lens[i]]]),
+            )
+            for i in np.flatnonzero(sel)
+        ])  # (k, 4): auc, mrr, ndcg5, ndcg10
+        for j, key in enumerate(("auc", "mrr", "ndcg5", "ndcg10")):
+            np.testing.assert_allclose(
+                device[key][sel].mean(), host[:, j].mean(),
+                rtol=1e-6, atol=1e-6, err_msg=f"slice {s} metric {key}",
+            )
+
+
+def test_accumulator_matches_direct_slice_means():
+    rng = np.random.default_rng(5)
+    n, bsz = 30, 8
+    vals = {k: rng.random(n) for k in ("auc", "mrr", "ndcg5", "ndcg10")}
+    keep = (rng.random(n) > 0.2).astype(np.float64)
+    from fedrec_tpu.obs.quality import SliceDef
+
+    masks = [rng.random(n) < 0.5 for _ in range(2)]
+    defs = [SliceDef(f"s{i}", m) for i, m in enumerate(masks)]
+    acc = SlicedEvalAccumulator(defs, n)
+    pad = (-n) % bsz
+    pvals = {k: np.concatenate([v, np.zeros(pad)]) for k, v in vals.items()}
+    pkeep = np.concatenate([keep, np.zeros(pad)])
+    for b in range(0, n + pad, bsz):
+        acc.add(
+            b, {k: v[b:b + bsz] for k, v in pvals.items()}, pkeep[b:b + bsz]
+        )
+    slices, skipped = acc.finalize()
+    for i, m in enumerate(masks):
+        w = m * keep
+        if w.sum() == 0:
+            assert f"s{i}" in skipped
+            continue
+        for k in vals:
+            np.testing.assert_allclose(
+                slices[f"s{i}"][k], np.dot(w, vals[k]) / w.sum(), rtol=1e-12
+            )
+        assert slices[f"s{i}"]["count"] == w.sum()
+
+
+# --------------------------------------- in-graph quality stats (hand-exact)
+def test_quality_stats_batch_matches_numpy_reference():
+    rng = np.random.default_rng(7)
+    B, P, bins, rng_hi, ece_bins = 16, 6, 10, 4.0, 5
+    pos = rng.standard_normal(B) * 2
+    neg = rng.standard_normal((B, P)) * 2
+    mask = (rng.random((B, P)) < 0.7).astype(np.float32)
+    keep = (rng.random(B) > 0.25).astype(np.float32)
+
+    out = quality_stats_batch(
+        jnp.asarray(pos), jnp.asarray(neg), jnp.asarray(mask),
+        jnp.asarray(keep), bins, rng_hi, ece_bins,
+    )
+    got = {k: np.asarray(out[k], np.float64) for k in QUALITY_SUM_KEYS}
+
+    def ref_hist(v, w, lo, hi, nb):
+        width = (hi - lo) / nb
+        idx = np.clip(np.floor((v - lo) / width), 0, nb - 1).astype(int)
+        h = np.zeros(nb)
+        np.add.at(h, idx.reshape(-1), w.reshape(-1))
+        return h
+
+    nw = mask * keep[:, None]
+    np.testing.assert_allclose(
+        got["q.pos_hist"], ref_hist(pos, keep, -rng_hi, rng_hi, bins), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        got["q.neg_hist"], ref_hist(neg, nw, -rng_hi, rng_hi, bins), atol=1e-5
+    )
+    np.testing.assert_allclose(got["q.pos_n"], keep.sum(), rtol=1e-6)
+    np.testing.assert_allclose(got["q.neg_n"], nw.sum(), rtol=1e-6)
+    np.testing.assert_allclose(got["q.pos_sum"], (pos * keep).sum(), rtol=1e-5)
+    np.testing.assert_allclose(got["q.neg_sq"], (neg**2 * nw).sum(), rtol=1e-5)
+    pp, pn = 1 / (1 + np.exp(-pos)), 1 / (1 + np.exp(-neg))
+    np.testing.assert_allclose(
+        got["q.cal_n"],
+        ref_hist(pp, keep, 0, 1, ece_bins) + ref_hist(pn, nw, 0, 1, ece_bins),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        got["q.cal_label"], ref_hist(pp, keep, 0, 1, ece_bins), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        got["q.cal_conf"],
+        ref_hist(pp, pp * keep, 0, 1, ece_bins)
+        + ref_hist(pn, pn * nw, 0, 1, ece_bins),
+        atol=1e-5,
+    )
+
+
+def test_ece_hand_exact_on_constructed_reliability_table():
+    """Two live bins: bin0 perfectly calibrated (acc=conf=0.25), bin1 with
+    conf 0.8 vs acc 0.5 over 6 of 10 candidates -> ECE = 0.6*0.3 = 0.18."""
+    ece_bins = 2
+    acc = {k: np.zeros(1) for k in QUALITY_SUM_KEYS}
+    acc["q.cal_n"] = np.array([4.0, 6.0])
+    acc["q.cal_conf"] = np.array([1.0, 4.8])    # conf .25 / .8
+    acc["q.cal_label"] = np.array([1.0, 3.0])   # acc  .25 / .5
+    acc["q.pos_hist"] = acc["q.neg_hist"] = np.zeros(2)
+    acc["q.pos_n"] = acc["q.neg_n"] = np.array(0.0)
+    acc["q.pos_sum"] = acc["q.pos_sq"] = np.array(0.0)
+    acc["q.neg_sum"] = acc["q.neg_sq"] = np.array(0.0)
+    dist = reduce_quality_sums(acc, ece_bins)
+    assert dist["ece"] == pytest.approx(0.18, abs=1e-12)
+    assert dist["calibration"][1]["confidence"] == pytest.approx(0.8)
+    assert dist["calibration"][1]["accuracy"] == pytest.approx(0.5)
+
+
+def test_separation_stats_hand_exact():
+    acc = {k: np.zeros(3) for k in ("q.cal_n", "q.cal_conf", "q.cal_label")}
+    acc["q.pos_hist"] = acc["q.neg_hist"] = np.zeros(4)
+    acc["q.pos_sum"], acc["q.pos_sq"], acc["q.pos_n"] = 6.0, 14.0, 3.0  # 1,2,3
+    acc["q.neg_sum"], acc["q.neg_sq"], acc["q.neg_n"] = 0.0, 2.0, 2.0   # -1,1
+    dist = reduce_quality_sums(acc, 3)
+    assert dist["pos_mean"] == pytest.approx(2.0)
+    assert dist["pos_std"] == pytest.approx(np.sqrt(2 / 3))
+    assert dist["neg_mean"] == pytest.approx(0.0)
+    assert dist["neg_std"] == pytest.approx(1.0)
+    assert dist["separation"] == pytest.approx(2.0)
+    assert dist["dprime"] == pytest.approx(
+        2.0 / np.sqrt((2 / 3 + 1.0) / 2.0)
+    )
+
+
+# -------------------------------------------------------------- drift probe
+def test_drift_probe_hand_exact():
+    """One injected probe [1, 0]: scores are the rows' x-coords, so the
+    shift and the top-2 churn are computable by hand."""
+    reg = MetricsRegistry()
+    probe = DriftProbe(num_probes=1, topk=2, seed=0, registry=reg)
+    probe._probes[2] = np.array([[1.0, 0.0]])
+    old = np.array([[5.0, 9], [4.0, 9], [1.0, 9], [0.0, 9]])
+    # row 3 jumps to the top: top-2 {0,1} -> {3,0}, jaccard 1/3; x-shifts
+    # are 0, 0.5, 0, 6 -> mean 1.625, max 6
+    new = np.array([[5.0, 9], [3.5, 9], [1.0, 9], [6.0, 9]])
+    r = probe.compare(old, None, new, None)
+    assert r["topk_jaccard"] == pytest.approx(1 / 3)
+    assert r["rank_churn"] == pytest.approx(2 / 3)
+    assert r["score_shift_mean"] == pytest.approx(1.625)
+    assert r["score_shift_max"] == pytest.approx(6.0)
+
+    # identical generation => exactly zero drift
+    r0 = probe.compare(old, None, old, None)
+    assert r0["score_shift_mean"] == 0.0
+    assert r0["score_shift_max"] == 0.0
+    assert r0["topk_jaccard"] == 1.0 and r0["rank_churn"] == 0.0
+    assert reg.get("serve.drift_checks_total").value() == 2
+
+
+def test_drift_probe_respects_valid_mask_and_size_change():
+    reg = MetricsRegistry()
+    probe = DriftProbe(num_probes=1, topk=1, seed=0, registry=reg)
+    probe._probes[2] = np.array([[1.0, 0.0]])
+    old = np.array([[9.0, 0], [1.0, 0]])
+    mask = np.array([False, True])  # the 9.0 row must never rank
+    r = probe.compare(old, mask, old, mask)
+    assert r["topk_jaccard"] == 1.0 and r["score_shift_mean"] == 0.0
+    # grown catalog: ranks compare, per-row score deltas do not
+    grown = np.array([[9.0, 0], [1.0, 0], [2.0, 0]])
+    r2 = probe.compare(old, None, grown, None)
+    assert r2["comparable"] is False
+    assert "score_shift_mean" not in r2
+    assert "topk_jaccard" in r2
+
+
+def test_store_publish_probes_before_swap():
+    from fedrec_tpu.serving.store import EmbeddingStore
+
+    reg = MetricsRegistry()
+    store = EmbeddingStore(registry=reg)
+    base = {"generation", "swap_count", "round", "source", "num_news",
+            "staleness_sec"}
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((50, 16)).astype(np.float32)
+    store.publish(vecs, {"w": 1})
+    assert set(store.metrics()) == base  # probe-less store: pre-PR keys
+
+    store.enable_drift_probe(num_probes=4, topk=5, seed=0)
+    store.publish(vecs.copy(), {"w": 1})
+    m = store.metrics()
+    assert base < set(m)  # strict superset with the drift verdict
+    assert m["drift_score_shift_mean"] == 0.0 and m["drift_rank_churn"] == 0.0
+    corrupt = vecs + 5 * rng.standard_normal(vecs.shape).astype(np.float32)
+    gen = store.publish(corrupt, {"w": 1}, source="bad")
+    m = store.metrics()
+    assert m["drift_score_shift_mean"] > 0 and m["drift_rank_churn"] > 0
+    assert gen.generation == store.current().generation  # swap still happened
+    assert reg.get("serve.drift_checks_total").value() == 2
+
+
+def test_digest_clients_flags_outliers_and_respects_ignore():
+    from fedrec_tpu.obs.quality import QualityMonitor
+
+    qcfg = ExperimentConfig().obs.quality
+    qcfg.outlier_auc_drop = 0.05
+    reg = MetricsRegistry()
+    mon = QualityMonitor(qcfg, registry=reg)
+    per = [{"auc": 0.70}, {"auc": 0.71}, {"auc": 0.69}, {"auc": 0.55}]
+    out = mon.digest_clients(3, per)
+    assert [o["client"] for o in out] == [3]
+    assert out[0]["auc"] == pytest.approx(0.55)
+    assert reg.get("eval.quality_outlier_clients_total").value() == 1
+    assert reg.get("eval.client_auc").value(client="3") == pytest.approx(0.55)
+
+    # a quarantined client keeps its gauge (the eval is real) but is
+    # excluded from the median AND from flagging
+    out2 = mon.digest_clients(4, per, ignore_clients={3})
+    assert out2 == []
+    assert reg.get("eval.client_auc").value(client="3") == pytest.approx(0.55)
+    # resync: the shared value overwrites EVERY previously-published
+    # client cell — no diverged-era gauge survives as if it were fresh
+    out3 = mon.digest_clients(5, None, shared={"auc": 0.66})
+    assert out3 == []
+    for c in ("0", "1", "2", "3"):
+        assert reg.get("eval.client_auc").value(client=c) == pytest.approx(0.66)
+
+
+# ------------------------------------------------- trainer e2e + degenerate
+def _quality_trainer(tmp_path, enabled: bool, registry: MetricsRegistry):
+    from fedrec_tpu.obs.registry import set_registry
+    from fedrec_tpu.train.trainer import Trainer
+
+    set_registry(registry)
+    cfg = small_cfg(optim__user_lr=3e-3)
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.num_clients = 2
+    cfg.fed.rounds = 1
+    cfg.train.eval_every = 1
+    cfg.train.eval_protocol = "full"
+    cfg.train.snapshot_dir = str(tmp_path / f"snap_{enabled}")
+    cfg.obs.dir = str(tmp_path / f"obs_{enabled}")
+    cfg.obs.quality.enabled = enabled
+    cfg.obs.quality.hist_len_edges = "4,7"
+    data, _, token_states, *_ = make_setup(cfg, num_train=64, seed=0)
+    t = Trainer(cfg, data, np.asarray(token_states))
+    hist = t.run()
+    return cfg, t, hist
+
+
+def test_trainer_quality_e2e_and_degenerate(tmp_path):
+    """The acceptance pin: obs.quality.enabled=false leaves the eval
+    trajectory identical to pre-PR, enabled publishes >= 8 slices, the
+    distribution digest, the artifacts render a Quality section, and the
+    unified val_* key scheme lands in the event log."""
+    from fedrec_tpu.obs.report import (
+        build_report,
+        load_jsonl,
+        quality_detail_from_snapshot,
+    )
+
+    reg_off = MetricsRegistry()
+    cfg0, t0, h0 = _quality_trainer(tmp_path, False, reg_off)
+    reg_on = MetricsRegistry()
+    cfg1, t1, h1 = _quality_trainer(tmp_path, True, reg_on)
+
+    # degenerate contract: identical eval metrics, quality layer absent
+    m0, m1 = h0[-1].val_metrics, h1[-1].val_metrics
+    for k in m0:
+        assert m0[k] == pytest.approx(m1[k], abs=1e-7), k
+    assert t0.quality is None and t0.full_eval_step_q is None
+    assert reg_off.get("eval.auc") is None  # no quality instruments exist
+
+    # enabled: slices + distribution + per-client value published
+    slices = t1.quality.last_slices
+    assert len(slices) >= 8, sorted(slices)
+    assert all(m["count"] > 0 for m in slices.values())
+    dist = t1.quality.last_distribution
+    assert np.isfinite(dist["ece"]) and "separation" in dist
+    cells = {
+        tuple(c["labels"].items()): c["value"]
+        for c in reg_on.get("eval.auc")._snapshot_values()
+    }
+    assert (("slice", "all"),) in cells
+    assert cells[(("slice", "all"),)] == pytest.approx(m1["auc"], abs=1e-7)
+    assert reg_on.get("eval.client_auc").value(client="0") is not None
+
+    # the event log carries the UNIFIED key scheme only
+    records, snapshots = load_jsonl(Path(cfg1.obs.dir) / "metrics.jsonl")
+    evals = [r for r in records if "val_auc" in r]
+    assert evals and "valid_auc" not in evals[-1] and "val_ndcg@5" not in evals[-1]
+    assert "val_ndcg5" in evals[-1]
+
+    # report: Quality section + last_eval through the new keys
+    report = build_report(records, snapshots)
+    assert report["training"]["last_eval"]["val_auc"] == pytest.approx(
+        m1["auc"], abs=1e-6
+    )
+    ql = report["quality"]
+    assert ql["corpus_auc"] == pytest.approx(m1["auc"], abs=1e-7)
+    assert ql["worst_slice"] in slices
+    detail = quality_detail_from_snapshot(snapshots[-1])
+    assert set(slices) <= set(detail["slices"])
+    for name, m in slices.items():
+        for key in ("auc", "mrr", "ndcg5", "ndcg10"):
+            assert detail["slices"][name][key] == pytest.approx(
+                m[key], abs=1e-7
+            )
+
+    # quality-off artifacts carry NO quality section
+    records0, snapshots0 = load_jsonl(Path(cfg0.obs.dir) / "metrics.jsonl")
+    assert "quality" not in build_report(records0, snapshots0)
+    assert quality_detail_from_snapshot(snapshots0[-1]) == {}
+
+
+def test_report_legacy_val_keys_fallback():
+    """Pre-rename artifacts (valid_auc / val_ndcg@5) still render, mapped
+    onto the unified key names."""
+    from fedrec_tpu.obs.report import build_report
+
+    records = [
+        {"round": 0, "training_loss": 1.2, "elapsed_sec": 1.0,
+         "valid_auc": 0.61, "valid_mrr": 0.3, "val_ndcg@5": 0.31,
+         "val_ndcg@10": 0.4},
+    ]
+    report = build_report(records, [])
+    assert report["training"]["last_eval"] == {
+        "val_auc": 0.61, "val_mrr": 0.3, "val_ndcg5": 0.31, "val_ndcg10": 0.4,
+    }
+
+
+def test_quality_cli(tmp_path):
+    """`fedrec-obs quality` renders the slice table from artifacts and
+    exits 2 on a quality-less run."""
+    reg = MetricsRegistry()
+    g = reg.gauge("eval.auc", "t", labels=("slice",))
+    g.set(0.7, slice="all")
+    g.set(0.42, slice="category=b1")
+    reg.gauge("eval.slice_impressions", "t", labels=("slice",)).set(
+        64, slice="category=b1"
+    )
+    reg.gauge("eval.ece", "t").set(0.12)
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    reg.write_snapshot(obs / "metrics.jsonl")
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.obs", "quality", str(obs)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "category=b1" in proc.stdout and "ece: 0.12" in proc.stdout
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    MetricsRegistry().write_snapshot(empty / "metrics.jsonl")
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "fedrec_tpu.cli.obs", "quality", str(empty)],
+        capture_output=True, text=True,
+    )
+    assert proc2.returncode == 2
+    assert "no quality telemetry" in proc2.stderr
+
+
+def test_fleet_report_quality_section(tmp_path):
+    """The fleet report surfaces per-worker quality (corpus auc, worst
+    slice, drift churn) from worker snapshots."""
+    from fedrec_tpu.obs.fleet import build_fleet_report, load_fleet_dir
+
+    reg = MetricsRegistry()
+    g = reg.gauge("eval.auc", "t", labels=("slice",))
+    g.set(0.71, slice="all")
+    g.set(0.55, slice="category=b2")
+    g.set(0.64, slice="category=b3")
+    reg.gauge("serve.drift_rank_churn", "t").set(0.25)
+    w0 = tmp_path / "worker_0"
+    w0.mkdir()
+    reg.write_snapshot(w0 / "metrics.jsonl")
+    workers = load_fleet_dir(tmp_path)
+    report = build_fleet_report(workers)
+    qw = report["quality"]["0"]
+    assert qw["auc"] == pytest.approx(0.71)
+    assert qw["worst_slice"] == "category=b2"
+    assert qw["worst_slice_auc"] == pytest.approx(0.55)
+    assert qw["drift_rank_churn"] == pytest.approx(0.25)
