@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -61,6 +61,14 @@ quality-gate:
 # the swap), and a forced-regression gate-failure leg
 quality-smoke:
 	@bash scripts/quality_smoke.sh
+
+# perf-regression gate: seeded CPU measurement of the flagship step +
+# host pipeline (steps/s, batch-build/h2d ms, dispatch gaps, analytic
+# FLOPs); banks a provenance-stamped benchmarks/perf_gate.json on first
+# run, then fails (naming the lane) on any noise-adjusted regression vs
+# the banked baseline — the perf analog of quality-gate
+perf-gate:
+	@python benchmarks/perf_gate.py
 
 # communication-cost benchmark: measured per-codec wire buffers of the
 # flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);

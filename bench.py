@@ -44,14 +44,15 @@ import numpy as np
 
 _INNER = "FEDREC_BENCH_INNER"  # value: "tpu" | "cpu"
 
-# chip-name fragment -> (bf16 peak FLOP/s, f32 peak FLOP/s) per chip
-_PEAK_FLOPS = {
-    "v5 lite": (197e12, 49e12),   # v5e
-    "v5e": (197e12, 49e12),
-    "v4": (275e12, 137e12),
-    "v5p": (459e12, 229e12),
-    "v6": (918e12, 459e12),       # trillium
-}
+# THE peak-FLOPs table and analytic step-FLOPs model live in
+# fedrec_tpu.obs.perf (one definition serving this bench's headline MFU,
+# step_profile.py's roofline, the live perf.mfu gauge and the banked
+# perf gate); imported back under the historical names so downstream
+# readers of bench.py keep working.
+from fedrec_tpu.obs.perf import (  # noqa: E402
+    PEAK_FLOPS as _PEAK_FLOPS,
+    flops_per_train_step as _flops_per_train_step,
+)
 
 
 def _probe_accelerator(attempts: int = 3, timeout_s: int = 150) -> bool:
@@ -107,38 +108,6 @@ def _reexec(platform: str) -> None:
         env = dict(os.environ)
     env[_INNER] = platform
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
-
-
-def _flops_per_train_step(cfg, batch_size: int, num_news: int) -> float:
-    """Analytic matmul FLOPs for one joint-mode train step (fwd + bwd).
-
-    Counts the dominating dense ops; backward ~= 2x forward for matmuls.
-    """
-    B = batch_size
-    C = 1 + cfg.data.npratio
-    H = cfg.data.max_his_len
-    L = cfg.data.max_title_len
-    Dh = cfg.model.bert_hidden
-    D = cfg.model.news_dim
-    heads, dk = cfg.model.num_heads, cfg.model.head_dim
-    Q = cfg.model.query_dim
-
-    # unique-news slots encoded per step — resolved through the SAME policy
-    # the compiled step uses (global cap or per-B buckets), so the FLOPs
-    # model can never over-count text-tower work the step skipped
-    from fedrec_tpu.train.step import resolve_unique_cap
-
-    size = min(B * (C + H), num_news)
-    cap = resolve_unique_cap(cfg, B)
-    if cap:
-        size = min(size, cap)
-    att_hidden = Dh // 2               # text-head additive attention hidden
-    text = size * (2 * L * Dh * att_hidden + 2 * L * att_hidden + 2 * Dh * D)
-    mha = B * (3 * 2 * H * D * D + 2 * 2 * heads * H * H * dk + 2 * H * D)
-    pool = B * (2 * H * D * Q + 2 * H * Q)
-    score = B * 2 * C * D
-    fwd = text + mha + pool + score
-    return 3.0 * fwd  # fwd + ~2x fwd for backward
 
 
 def _baseline_ratios(

@@ -43,6 +43,16 @@ if _REPO not in sys.path:
 
 from pallas_bench import _time  # noqa: E402  (same honest timer)
 
+# peaks, verdict spellings and the analytic FLOPs model are shared with
+# bench.py's headline MFU and the live per-round gauges via ONE module
+# (fedrec_tpu.obs.perf) — the artifacts, the bench and the telemetry can
+# never desync on a number or a verdict string
+from fedrec_tpu.obs.perf import (  # noqa: E402
+    CHIP_PEAKS as _PEAKS,
+    flops_per_train_step as _flops_per_train_step,
+    roofline_verdict,
+)
+
 def _host_pipeline_rows(
     step_fn, B: int, C: int, H: int, num_news: int, on_cpu: bool
 ) -> dict:
@@ -192,23 +202,6 @@ def _host_pipeline_rows(
     return rows
 
 
-# ONE spelling of the input-bound verdict: the CPU and chip artifacts must
-# never desync on the string readers/docs consume
-_INPUT_BOUND = (
-    "input-bound: host batch build + transfer >= the device step; "
-    "overlap the pipeline (data.prefetch_batches)"
-)
-
-# chip-name fragment -> (bf16 peak FLOP/s, f32 peak FLOP/s, HBM GB/s)
-_PEAKS = {
-    "v5 lite": (197e12, 49e12, 819e9),
-    "v5e": (197e12, 49e12, 819e9),
-    "v4": (275e12, 137e12, 1228e9),
-    "v5p": (459e12, 229e12, 2765e9),
-    "v6": (918e12, 459e12, 1640e9),
-}
-
-
 def main() -> int:
     import argparse
 
@@ -254,11 +247,6 @@ def main() -> int:
     user_p = variables["params"]["user_encoder"]
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
     peaks = next((v for f, v in _PEAKS.items() if f in kind), None)
-
-    # THE flops model is bench.py's — imported, not duplicated, so the
-    # roofline 'mfu' here and the headline 'mfu_estimate' there can never
-    # drift apart
-    from bench import _flops_per_train_step
 
     def flops_of(B: int, U: int) -> float:
         return _flops_per_train_step(cfg, B, num_news)
@@ -482,25 +470,16 @@ def main() -> int:
                 entry["mfu"] = round(fl / t_full / peak_fl, 4)
                 entry["hbm_fraction"] = round(by / t_full / peak_bw, 4)
                 entry["ridge_intensity"] = round(peak_fl / peak_bw, 1)
-                bound = (
-                    _INPUT_BOUND
-                    if input_bound
-                    else "memory-bound" if entry["hbm_fraction"] >= 0.6
-                    else "compute-bound" if entry["mfu"] >= 0.6
-                    else "neither peak approached: dispatch/latency/fusion "
-                         "headroom"
+                _, bound = roofline_verdict(
+                    input_bound, mfu=entry["mfu"],
+                    hbm_fraction=entry["hbm_fraction"],
                 )
                 entry["verdict"] = bound
                 print(f"B={B:5d} roofline: MFU {entry['mfu']:.3f}, "
                       f"HBM {entry['hbm_fraction']:.3f} of peak -> {bound}",
                       flush=True)
             else:
-                entry["verdict"] = (
-                    _INPUT_BOUND
-                    if input_bound
-                    else "device-bound on this backend (host pipeline "
-                         "subdominant; roofline fractions need a chip run)"
-                )
+                _, entry["verdict"] = roofline_verdict(input_bound)
             _stamp(partial=True)
         except Exception as e:  # noqa: BLE001
             # a deterministic per-B failure (e.g. an OOM at the new large-B

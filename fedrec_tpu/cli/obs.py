@@ -33,6 +33,16 @@ Subcommands:
       and the serving store's last pre-swap drift verdict.  Exit 2 when
       the run carried no quality telemetry (obs.quality.enabled=false).
 
+  fedrec-obs perf <dir | metrics.jsonl> [--json]
+      Performance report off the obs.perf telemetry: last-round
+      throughput/MFU/HBM fraction, the per-round roofline-verdict
+      counts (canonical verdict strings), the host phase table
+      (batch_build/h2d/dispatch/aggregate/eval), the MFU trend over the
+      last rounds, HBM bytes by component, the compile-cost
+      (``cost_analysis``) table, and pointers to captured profiler
+      traces.  Exit 2 when the run carried no perf telemetry
+      (obs.perf.enabled=false).
+
   fedrec-obs replay <dir | flightrec dir> [--max-steps N] [--json]
       Re-execute the flight-recorder dump's recorded steps on CPU from
       the dumped chunk-entry state — deterministically confirming (and
@@ -249,6 +259,141 @@ def _cmd_quality(args) -> int:
                 f"{int(drift.get('checks', 0))} check(s)"
             )
         lines.append("")
+    print("\n".join(lines))
+    return 0
+
+
+# -------------------------------------------------------------------- perf
+def _cmd_perf(args) -> int:
+    from fedrec_tpu.obs.report import perf_detail_from_snapshot
+
+    metrics_path, trace_path = _resolve(args.path)
+    loaded = _load_event_log(metrics_path)
+    if isinstance(loaded, int):
+        return loaded
+    records, snapshots = loaded
+    if not snapshots:
+        return _fail(
+            f"no registry snapshot in {metrics_path} (the run may have "
+            "died before its first obs.snapshot_every round)"
+        )
+    detail = perf_detail_from_snapshot(snapshots[-1])
+    if not detail:
+        return _fail(
+            f"no perf telemetry in {metrics_path} — was the run started "
+            "with obs.perf.enabled=1 (live MFU/roofline gauges, "
+            "compile-cost telemetry, HBM attribution)?"
+        )
+    # the MFU/verdict trend rides the per-round MetricLogger records
+    trend = [
+        (r.get("round"), r.get("perf.mfu"), r.get("perf.samples_per_sec"),
+         r.get("perf.verdict"))
+        for r in records
+        if "perf.samples_per_sec" in r and "round" in r
+    ]
+    captures = [
+        r for r in records
+        if r.get("kind") in ("perf_capture", "profile_trace")
+    ]
+    phases = None
+    if trace_path:
+        try:
+            from fedrec_tpu.obs.fleet import ROUND_PHASES
+            from fedrec_tpu.obs.report import span_summary
+
+            # the same rollup build_report's span table uses, filtered to
+            # the round phases — the two views cannot drift on one trace
+            phases = span_summary(load_trace(trace_path), names=ROUND_PHASES)
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            print(f"fedrec-obs: skipping unreadable trace {trace_path}: {e}",
+                  file=sys.stderr)
+            phases = None
+    if args.json:
+        doc = dict(detail)
+        if trend:
+            doc["trend"] = [
+                {"round": r, "mfu": m, "samples_per_sec": s, "verdict": v}
+                for r, m, s, v in trend
+            ]
+        if captures:
+            # NOT "captures": perf_detail_from_snapshot already uses that
+            # key for the numeric counter — a consumer must never see the
+            # key's type flip between runs
+            doc["capture_records"] = captures
+        if phases:
+            doc["phases"] = phases
+        print(json.dumps(doc, indent=2))
+        return 0
+    lines = ["# fedrec_tpu perf report", ""]
+    head = []
+    if "samples_per_sec" in detail:
+        head.append(f"throughput: {detail['samples_per_sec']:.1f} samples/s")
+    if "mfu" in detail:
+        head.append(f"mfu: {detail['mfu']:.4f}")
+    if "hbm_fraction" in detail:
+        head.append(f"hbm: {detail['hbm_fraction']:.3f} of peak")
+    if head:
+        lines.append(", ".join(head) + " (last round)")
+    if "verdict_rounds" in detail:
+        from fedrec_tpu.obs.perf import ROOFLINE_VERDICTS
+
+        lines.append("")
+        lines.append("## Roofline verdicts")
+        for key, n in sorted(detail["verdict_rounds"].items()):
+            lines.append(
+                f"  {int(n):>4} round(s)  {ROOFLINE_VERDICTS.get(key, key)}"
+            )
+    if phases:
+        lines.append("")
+        lines.append("## Phase table (host spans)")
+        lines.append(f"{'phase':<14} {'count':>7} {'total_ms':>10} {'mean_ms':>9}")
+        for name, p in phases.items():
+            lines.append(
+                f"{name:<14} {p['count']:>7} {p['total_ms']:>10.1f} "
+                f"{p['mean_ms']:>9.2f}"
+            )
+    if trend:
+        lines.append("")
+        lines.append("## Trend (last 8 rounds)")
+        for r, m, s, v in trend[-8:]:
+            mfu_s = f" mfu={m:.4f}" if m is not None else ""
+            lines.append(
+                f"  r{int(r)}: {s:.1f} samples/s{mfu_s}"
+                + (f" [{v}]" if v else "")
+            )
+    if "hbm_components" in detail:
+        lines.append("")
+        lines.append("## HBM by component (descending)")
+        for name, v in sorted(
+            detail["hbm_components"].items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(f"  {name:<12} {v / (1024 * 1024):>10.1f} MB")
+    if "compile_cost" in detail:
+        lines.append("")
+        lines.append("## Compile cost (xla cost_analysis)")
+        lines.append(
+            f"{'fn':<20} {'gflops':>10} {'MB_accessed':>12} {'intensity':>10}"
+        )
+        for fn, c in detail["compile_cost"].items():
+            gf = c.get("flops")
+            mb = c.get("bytes_accessed")
+            ai = c.get("arithmetic_intensity")
+            lines.append(
+                f"{fn:<20} "
+                f"{(gf / 1e9 if gf is not None else float('nan')):>10.2f} "
+                f"{(mb / 1e6 if mb is not None else float('nan')):>12.2f} "
+                f"{(ai if ai is not None else float('nan')):>10.1f}"
+            )
+    if captures:
+        lines.append("")
+        lines.append("## Captured traces")
+        for c in captures:
+            tag = c.get("kind")
+            rnd = c.get("round")
+            lines.append(
+                f"  {tag}" + (f" r{int(rnd)}" if rnd is not None else "")
+                + f": {c.get('logdir')}"
+            )
     print("\n".join(lines))
     return 0
 
@@ -521,6 +666,16 @@ def build_parser() -> argparse.ArgumentParser:
     qu.add_argument("--json", action="store_true",
                     help="machine-readable detail instead of text")
     qu.set_defaults(fn=_cmd_quality)
+    pf = sub.add_parser(
+        "perf",
+        help="performance report: MFU trend + roofline verdicts, phase "
+             "table, HBM attribution, compile-cost table (obs.perf "
+             "telemetry)",
+    )
+    pf.add_argument("path", help="obs dir or metrics.jsonl path")
+    pf.add_argument("--json", action="store_true",
+                    help="machine-readable detail instead of text")
+    pf.set_defaults(fn=_cmd_perf)
     rp = sub.add_parser(
         "replay",
         help="re-execute a flight-recorder dump on CPU to confirm/bisect",

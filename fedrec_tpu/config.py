@@ -520,6 +520,49 @@ class QualityConfig:
 
 
 @dataclass
+class PerfConfig:
+    """Performance observability (``fedrec_tpu.obs.perf``).
+
+    ``enabled`` turns on the live efficiency telemetry layer: per-round
+    ``perf.mfu`` / ``perf.samples_per_sec`` gauges priced with the SAME
+    analytic FLOPs model and peak-FLOPs table ``bench.py`` certifies
+    headline MFU with, a per-round roofline verdict
+    (compute/HBM/input-bound — one spelling with
+    ``benchmarks/step_profile.py``) derived from the existing
+    ``batch_build``/``h2d``/``dispatch`` span timings, compile-cost
+    telemetry (every watched XLA compilation records its
+    ``cost_analysis()`` FLOPs / bytes accessed into ``xla.cost_*``
+    gauges), and ``jax.live_arrays()`` HBM attribution
+    (``hbm.component_bytes{component=…}``) at round cadence.
+
+    Default OFF: with ``enabled=false`` none of this is constructed and
+    the train/serve paths run the exact pre-perf programs
+    (byte-identical trajectories, pinned in ``tests/test_perf.py``).
+    """
+
+    enabled: bool = False
+    # record lowered.compile().cost_analysis() (FLOPs / bytes accessed /
+    # arithmetic intensity) for every watched compilation; degrades
+    # gracefully on backends returning None/partial dicts
+    compile_cost: bool = True
+    # bucket jax.live_arrays() bytes by component (params / optimizer /
+    # news_table / batch / other) into hbm.component_bytes gauges at
+    # round cadence
+    hbm_components: bool = True
+    # triggered capture window: "N" wraps round N (only) in a
+    # jax.profiler trace under obs.dir/perf_capture_rNNNN; "N:K" wraps
+    # rounds [N, N+K). A pointer record lands in metrics.jsonl. Empty =
+    # no configured window.
+    capture_rounds: str = ""
+    # efficiency-drop trigger: when a round's samples/s falls this
+    # fraction below the trailing-window mean, capture the NEXT round
+    # (bounded at 3 triggered captures per run). 0 = off.
+    capture_drop: float = 0.0
+    # trailing rounds the drop trigger averages over
+    capture_window: int = 8
+
+
+@dataclass
 class FleetConfig:
     """Fleet-wide telemetry (``fedrec_tpu.obs.fleet``).
 
@@ -562,6 +605,7 @@ class ObsConfig:
     health: HealthConfig = field(default_factory=HealthConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     quality: QualityConfig = field(default_factory=QualityConfig)
+    perf: PerfConfig = field(default_factory=PerfConfig)
 
 
 @dataclass

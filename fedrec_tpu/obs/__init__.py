@@ -27,6 +27,14 @@ Three pieces, one namespace:
   digests, and the serving store's pre-swap drift probe
   (``serve.drift_*``); the banked regression gate is
   ``benchmarks/quality_gate.py``.
+* :mod:`fedrec_tpu.obs.perf` — performance observability: the shared
+  peak-FLOPs table + analytic step-FLOPs model (one definition serving
+  ``bench.py``, ``benchmarks/step_profile.py`` and the live gauges),
+  the one-spelling roofline verdict, compile-cost telemetry
+  (``cost_analysis`` via the CompileWatchdog hook), ``jax.live_arrays``
+  HBM attribution, per-round ``perf.mfu``/throughput gauges and
+  triggered profiler capture windows; the banked regression gate is
+  ``benchmarks/perf_gate.py``.
 * :mod:`fedrec_tpu.obs.fleet` — fleet-wide observability: worker/rank/
   membership-epoch correlation keys on every span and JSONL record, a
   round-cadence telemetry collector with an offline ``worker_*`` merge
@@ -84,10 +92,18 @@ from fedrec_tpu.obs.device import (
     sample_device_memory,
     set_active_watchdog,
 )
+from fedrec_tpu.obs.perf import (
+    CostAnalysisRecorder,
+    PerfMonitor,
+    flops_per_train_step,
+    live_array_components,
+    roofline_verdict,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "CompileWatchdog",
+    "CostAnalysisRecorder",
     "Counter",
     "DriftProbe",
     "FleetPusher",
@@ -96,6 +112,7 @@ __all__ = [
     "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "PerfMonitor",
     "QualityMonitor",
     "SlicedEvalAccumulator",
     "TelemetryCollector",
@@ -105,12 +122,15 @@ __all__ = [
     "build_slice_defs",
     "dump_artifacts",
     "ensure_fleet_identity",
+    "flops_per_train_step",
     "get_fleet_identity",
     "get_registry",
     "get_tracer",
+    "live_array_components",
     "load_jsonl",
     "load_trace",
     "render_text",
+    "roofline_verdict",
     "restore_counter_baseline",
     "rotate_jsonl",
     "sample_device_memory",

@@ -49,6 +49,11 @@ def _call_stack() -> list:
 
 
 def _on_event_duration(name: str, dur: float, **_kw: Any) -> None:
+    # events fired by the cost hook's own AOT re-compile are telemetry
+    # overhead, not program compiles — without the suppression they would
+    # double-count xla.compile_seconds_total (and read as <unwatched>)
+    if getattr(_tls, "suppress_compile_events", False):
+        return
     wd = _active
     if wd is not None and _COMPILE_EVENT_STEM in name:
         wd._on_compile(float(dur))
@@ -90,8 +95,15 @@ class CompileWatchdog:
         storm_threshold: int = 5,
         storm_window_s: float = 60.0,
         provenance_capacity: int = 100,
+        cost_cb: Callable | None = None,
     ):
         self.registry = registry or get_registry()
+        # obs.perf's compile-cost hook: called as cost_cb(fn, args,
+        # kwargs, name) after any watched call during which a NEW
+        # compilation fired, so the compiled executable's cost_analysis
+        # (FLOPs / bytes accessed) can be recorded with fn provenance.
+        # None (the default) keeps the pre-perf watch() behavior exactly.
+        self.cost_cb = cost_cb
         self.storm_threshold = max(int(storm_threshold), 1)
         self.storm_window_s = float(storm_window_s)
         self._c_compiles = self.registry.counter(
@@ -205,17 +217,34 @@ class CompileWatchdog:
             # sig stays None until a compile event actually fires: after
             # warmup no event ever does, so the hot dispatch path pays one
             # dict append instead of a tree walk + string format per call
-            stack.append({
+            frame = {
                 "fn": name,
                 "sig": None,
                 "args": args,
                 "kwargs": kwargs,
                 "counted": False,
-            })
+            }
+            stack.append(frame)
             try:
                 return fn(*args, **kwargs)
             finally:
                 stack.pop()
+                # compile-cost hook (obs.perf): only after a call that
+                # actually compiled — the steady-state dispatch path never
+                # reaches it. Guarded: telemetry must never displace the
+                # call's own result or exception.
+                if frame["counted"] and self.cost_cb is not None:
+                    # the hook's lowered.compile() is an AOT compile that
+                    # does NOT share the jit dispatch cache — its own
+                    # backend_compile events must not count as program
+                    # compiles (suppressed above)
+                    _tls.suppress_compile_events = True
+                    try:
+                        self.cost_cb(fn, args, kwargs, name)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    finally:
+                        _tls.suppress_compile_events = False
 
         wrapped.__name__ = f"watched_{name}"
         return wrapped

@@ -981,6 +981,34 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
             quality[wid] = qw
     if quality:
         report["quality"] = quality
+
+    # ---- perf (obs.perf): per-worker last-round MFU/throughput and the
+    # dominant roofline verdict — the fleet view of the live efficiency
+    # gauges, compacted from the ONE shared extraction
+    # (report.perf_detail_from_snapshot). Silent when no worker published
+    # perf gauges.
+    from fedrec_tpu.obs.report import perf_detail_from_snapshot
+
+    perf: dict[str, Any] = {}
+    for wid in sorted(workers):
+        snap = workers[wid].last_snapshot()
+        if snap is None:
+            continue
+        detail = perf_detail_from_snapshot(snap)
+        if not detail:
+            continue
+        pw = {
+            key: detail[key]
+            for key in (
+                "samples_per_sec", "mfu", "hbm_fraction", "verdict",
+                "host_ms_per_step", "dispatch_ms_per_step",
+            )
+            if key in detail
+        }
+        if pw:
+            perf[wid] = pw
+    if perf:
+        report["perf"] = perf
     return report
 
 
@@ -1083,6 +1111,23 @@ def render_fleet_text(report: dict) -> str:
                     f"outlier client-evals="
                     f"{int(qw['quality_outlier_client_evals'])}"
                 )
+            lines.append(f"worker {wid}: " + ", ".join(parts))
+        lines.append("")
+    perf = report.get("perf")
+    if perf:
+        lines.append("## Perf by worker")
+        for wid, pw in perf.items():
+            parts = []
+            if "samples_per_sec" in pw:
+                parts.append(f"{pw['samples_per_sec']:.1f} samples/s")
+            if "mfu" in pw:
+                parts.append(f"mfu={pw['mfu']:.4f}")
+            if "hbm_fraction" in pw:
+                parts.append(f"hbm={pw['hbm_fraction']:.3f}")
+            if "host_ms_per_step" in pw:
+                parts.append(f"host={pw['host_ms_per_step']:.2f}ms/step")
+            if "verdict" in pw:
+                parts.append(f"verdict={pw['verdict']}")
             lines.append(f"worker {wid}: " + ", ".join(parts))
         lines.append("")
     if not report.get("workers"):

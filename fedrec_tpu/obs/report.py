@@ -222,6 +222,91 @@ def quality_detail_from_snapshot(snap: dict) -> dict:
     return detail
 
 
+def perf_detail_from_snapshot(snap: dict) -> dict:
+    """The performance digest out of one registry snapshot — the live
+    efficiency gauges (obs.perf), the per-round roofline verdict counts,
+    the HBM component attribution and the compile-cost table.  The
+    ``fedrec-obs perf`` verb renders this; ``build_report``'s Perf
+    section and the fleet report's per-worker perf columns are compact
+    subsets of the SAME extraction."""
+    detail: dict[str, Any] = {}
+    for key, name in (
+        ("samples_per_sec", "perf.samples_per_sec"),
+        ("mfu", "perf.mfu"),
+        ("hbm_fraction", "perf.hbm_fraction"),
+        ("step_flops", "perf.step_flops"),
+        ("host_ms_per_step", "perf.host_ms_per_step"),
+        ("dispatch_ms_per_step", "perf.dispatch_ms_per_step"),
+        ("captures", "perf.captures_total"),
+        ("capture_failures", "perf.capture_failures_total"),
+    ):
+        v = (
+            snapshot_total(snap, name)
+            if name == "perf.captures_total"  # labeled per reason: sum
+            else snapshot_value(snap, name)
+        )
+        if v is not None:
+            detail[key] = v
+    verdicts = {
+        row["labels"].get("verdict", "?"): row["value"]
+        for row in _metric_values(snap, "perf.roofline_rounds_total")
+        if "value" in row and row["value"] > 0
+    }
+    if verdicts:
+        detail["verdict_rounds"] = verdicts
+        detail["verdict"] = max(verdicts, key=verdicts.get)
+    hbm = {
+        row["labels"].get("component", "?"): row["value"]
+        for row in _metric_values(snap, "hbm.component_bytes")
+        if "value" in row
+    }
+    if hbm:
+        detail["hbm_components"] = hbm
+    cost: dict[str, dict] = {}
+    for key, name in (
+        ("flops", "xla.cost_flops"),
+        ("bytes_accessed", "xla.cost_bytes_accessed"),
+        ("arithmetic_intensity", "xla.cost_arithmetic_intensity"),
+    ):
+        for row in _metric_values(snap, name):
+            if "value" in row:
+                cost.setdefault(row["labels"].get("fn", "?"), {})[key] = (
+                    row["value"]
+                )
+    if cost:
+        detail["compile_cost"] = dict(sorted(cost.items()))
+    return detail
+
+
+def span_summary(
+    trace_events: list[dict], names: set | tuple | None = None
+) -> dict[str, dict]:
+    """Per-span-name `{count, total_ms, mean_ms, max_ms}` rollup over
+    Chrome-trace complete ("X") events — THE aggregation behind
+    ``build_report``'s span table and ``fedrec-obs perf``'s phase table
+    (one definition, so the two views cannot drift on the same trace).
+    ``names`` filters to a span subset (e.g. the round phases)."""
+    spans: dict[str, dict] = {}
+    for e in trace_events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name")
+        if names is not None and name not in names:
+            continue
+        s = spans.setdefault(
+            name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+        )
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        s["count"] += 1
+        s["total_ms"] += dur_ms
+        s["max_ms"] = max(s["max_ms"], dur_ms)
+    for s in spans.values():
+        s["total_ms"] = round(s["total_ms"], 3)
+        s["max_ms"] = round(s["max_ms"], 3)
+        s["mean_ms"] = round(s["total_ms"] / s["count"], 3) if s["count"] else 0.0
+    return spans
+
+
 # -------------------------------------------------------------- the report
 def build_report(
     records: list[dict],
@@ -532,6 +617,33 @@ def build_report(
                 ql["drift"] = detail["drift"]
             report["quality"] = ql
 
+        # ---- perf: the live efficiency gauges (obs.perf) — throughput,
+        # MFU, the roofline-verdict round counts, HBM attribution and
+        # compile cost, compacted from ONE extraction
+        # (perf_detail_from_snapshot, shared with `fedrec-obs perf` and
+        # the fleet report); silent on a perf-off run
+        pdetail = perf_detail_from_snapshot(last)
+        if pdetail:
+            pf_sec: dict[str, Any] = {}
+            for key in (
+                "samples_per_sec", "mfu", "hbm_fraction",
+                "host_ms_per_step", "dispatch_ms_per_step",
+                "verdict", "verdict_rounds", "captures",
+            ):
+                if key in pdetail:
+                    pf_sec[key] = pdetail[key]
+            if "hbm_components" in pdetail:
+                comps = {
+                    k: v for k, v in pdetail["hbm_components"].items() if v
+                }
+                if comps:
+                    pf_sec["hbm_top"] = max(comps, key=comps.get)
+                    pf_sec["hbm_components"] = comps
+            if "compile_cost" in pdetail:
+                pf_sec["compiled_fns"] = len(pdetail["compile_cost"])
+            if pf_sec:
+                report["perf"] = pf_sec
+
         # ---- cap overflows
         overflow = snapshot_value(last, "train.cap_overflow_total")
         if overflow is not None:
@@ -539,22 +651,7 @@ def build_report(
 
     # ---- span summary
     if trace_events:
-        spans: dict[str, dict] = {}
-        for e in trace_events:
-            if e.get("ph") != "X":
-                continue
-            s = spans.setdefault(
-                e["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
-            )
-            dur_ms = float(e.get("dur", 0.0)) / 1e3
-            s["count"] += 1
-            s["total_ms"] += dur_ms
-            s["max_ms"] = max(s["max_ms"], dur_ms)
-        for s in spans.values():
-            s["total_ms"] = round(s["total_ms"], 3)
-            s["max_ms"] = round(s["max_ms"], 3)
-            s["mean_ms"] = round(s["total_ms"] / s["count"], 3) if s["count"] else 0.0
-        report["spans"] = dict(sorted(spans.items()))
+        report["spans"] = dict(sorted(span_summary(trace_events).items()))
 
     return report
 
@@ -811,6 +908,52 @@ def render_text(report: dict) -> str:
             lines.append(
                 f"serving drift (last swap, {int(dr.get('checks', 0))} "
                 f"probe check(s)): " + ", ".join(parts)
+            )
+        lines.append("")
+    pfm = report.get("perf")
+    if pfm:
+        lines.append("## Perf")
+        head = []
+        if "samples_per_sec" in pfm:
+            head.append(f"throughput: {pfm['samples_per_sec']:.1f} samples/s")
+        if "mfu" in pfm:
+            head.append(f"mfu: {pfm['mfu']:.4f}")
+        if "hbm_fraction" in pfm:
+            head.append(f"hbm: {pfm['hbm_fraction']:.3f} of peak")
+        if head:
+            lines.append(", ".join(head) + " (last round)")
+        if "host_ms_per_step" in pfm or "dispatch_ms_per_step" in pfm:
+            lines.append(
+                f"per step: host {pfm.get('host_ms_per_step', 0):.2f} ms, "
+                f"dispatch {pfm.get('dispatch_ms_per_step', 0):.2f} ms"
+            )
+        if "verdict_rounds" in pfm:
+            counts = ", ".join(
+                f"{k}={int(v)}"
+                for k, v in sorted(pfm["verdict_rounds"].items())
+            )
+            lines.append(f"roofline verdicts (rounds): {counts}")
+        if "hbm_components" in pfm:
+            def _cmb(n: float) -> str:
+                return f"{n / (1024 * 1024):.1f} MB"
+
+            comps = ", ".join(
+                f"{k}={_cmb(v)}"
+                for k, v in sorted(
+                    pfm["hbm_components"].items(),
+                    key=lambda kv: -kv[1],
+                )
+            )
+            lines.append(f"hbm by component: {comps}")
+        if "captures" in pfm and pfm["captures"]:
+            lines.append(
+                f"capture windows: {int(pfm['captures'])} "
+                "(see perf_capture_* under the obs dir)"
+            )
+        if "compiled_fns" in pfm:
+            lines.append(
+                f"compile-cost rows: {int(pfm['compiled_fns'])} "
+                "(fedrec-obs perf for the table)"
             )
         lines.append("")
     if "cap_overflow_steps" in report:

@@ -156,6 +156,20 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def event_count(self) -> int:
+        """How many events are recorded — pair with :meth:`events_since`
+        for incremental readers (obs.perf digests only the spans of the
+        round that just ended) without copying the whole ring each
+        round."""
+        with self._lock:
+            return len(self._events)
+
+    def events_since(self, start: int) -> list[dict]:
+        """The events recorded at index ``start`` onward (a prior
+        :meth:`event_count` reading)."""
+        with self._lock:
+            return list(self._events[start:])
+
     def to_chrome(self) -> dict:
         """Chrome trace event JSON object; events sorted by ``ts`` so the
         exported timeline is monotonic."""

@@ -965,10 +965,37 @@ class Trainer:
                 dump_policy=hcfg.dump_policy,
                 dump_table_max_mb=hcfg.dump_table_max_mb,
             )
+        # ---- performance observability (fedrec_tpu.obs.perf): live MFU /
+        # samples-per-sec / roofline-verdict gauges off the round's span
+        # timings, compile-cost telemetry via the watchdog hook, HBM
+        # attribution at round cadence, triggered capture windows.
+        # Default OFF — nothing below is constructed and the watchdog
+        # keeps its exact pre-perf behavior (cost_cb=None).
+        self.perf = None
+        self._perf_last_batch = None
+        # retain the last sharded batch ONLY when the HBM-attribution
+        # pass will actually read it — a pinned (steps, clients, B, ...)
+        # stack with no consumer would hold a chunk of device memory
+        # across rounds for nothing
+        self._perf_keep_batch = False
+        if cfg.obs.perf.enabled:
+            from fedrec_tpu.obs.perf import PerfMonitor
+
+            self.perf = PerfMonitor(
+                cfg.obs.perf, cfg, data.num_news,
+                registry=self.registry, tracer=self.tracer,
+                obs_dir=self._obs_dir,
+            )
+            self._perf_keep_batch = cfg.obs.perf.hbm_components
         self.watchdog = CompileWatchdog(
             registry=self.registry,
             storm_threshold=hcfg.storm_threshold,
             storm_window_s=hcfg.storm_window_s,
+            cost_cb=(
+                self.perf.cost
+                if self.perf is not None and cfg.obs.perf.compile_cost
+                else None
+            ),
         )
         self.watchdog.install()
         # every jitted program goes through the watchdog so each XLA
@@ -2071,6 +2098,32 @@ class Trainer:
         if self._a2a_bytes_per_step:
             self._m_a2a_bytes.inc(float(n * self._a2a_bytes_per_step))
 
+    def _perf_sample_components(self, round_idx: int) -> None:
+        """HBM attribution at round cadence (obs.perf.hbm_components):
+        bucket ``jax.live_arrays()`` bytes into params / optimizer /
+        news_table / batch / other gauges.  Classification is by leaf
+        identity against the CURRENT state pytrees, so donated buffers
+        (no longer live) simply drop out."""
+        if self.perf is None or not self.cfg.obs.perf.hbm_components:
+            return
+        from fedrec_tpu.obs.perf import live_array_components
+
+        st = self.state
+        table = self.token_states
+        if table is None:
+            table = self.news_tokens if self.mode == "finetune" else self._table
+        live_array_components(
+            {
+                "params": (st.user_params, st.news_params),
+                "optimizer": (st.opt_user, st.opt_news),
+                "news_table": table,
+                "batch": self._perf_last_batch,
+            },
+            registry=self.registry,
+            tracer=self.tracer,
+            fed_round=round_idx,
+        )
+
     def _chaos_batch_keys(self, round_idx: int) -> dict | None:
         """Per-client fault vectors every chaos-enabled batch must carry
         (``train.step`` applies them at the update boundary)."""
@@ -2089,6 +2142,8 @@ class Trainer:
         # cohort first (and before the span, whose args describe it): the
         # draw + sidecar install define who this round even is
         self._ensure_cohort(round_idx)
+        if self.perf is not None:
+            self.perf.begin_round()
         with self.tracer.span(
             "fed_round", step_num=round_idx, num_rounds=1,
             **self._round_span_args(),
@@ -2099,7 +2154,11 @@ class Trainer:
             sample_device_memory(
                 self.registry, self.tracer, fed_round=round_idx
             )
-        self._m_round_secs.observe(_time.perf_counter() - t0)
+            self._perf_sample_components(round_idx)
+        wall = _time.perf_counter() - t0
+        self._m_round_secs.observe(wall)
+        if self.perf is not None:
+            self.perf.observe_round(round_idx, 1, wall)
         return result
 
     def _train_round_inner(self, round_idx: int) -> RoundResult:
@@ -2161,6 +2220,8 @@ class Trainer:
                     stacked = shard_scan_batches(
                         self.mesh, stack_batches(group), cfg
                     )
+                if self._perf_keep_batch:
+                    self._perf_last_batch = stacked
                 with tracer.span("dispatch", kind="scan_chain", n=len(group)):
                     self.state, metrics = self.train_scan(
                         self.state, stacked, table
@@ -2169,6 +2230,8 @@ class Trainer:
                 for g in group:
                     with tracer.span("h2d", n=1):
                         sharded = shard_fed_batch(self.mesh, g, cfg)
+                    if self._perf_keep_batch:
+                        self._perf_last_batch = sharded
                     with tracer.span("dispatch", kind="step", n=1):
                         self.state, metrics = self.train_step(
                             self.state, sharded, table
@@ -2402,6 +2465,8 @@ class Trainer:
         # rotation under rounds-in-jit happens at chunk cadence, a
         # documented divergence from the host-driven per-round rotation
         self._ensure_cohort(round_idx)
+        if self.perf is not None:
+            self.perf.begin_round()
         chunk_span = self.tracer.span(
             "fed_round", step_num=round_idx, num_rounds=num_rounds,
             **self._round_span_args(),
@@ -2414,11 +2479,17 @@ class Trainer:
             sample_device_memory(
                 self.registry, self.tracer, fed_round=round_idx
             )
+            self._perf_sample_components(round_idx)
         # the chunk is one dispatch; attribute its wall time evenly so the
         # per-round histogram stays comparable across dispatch modes
-        per_round = (_time.perf_counter() - t0) / num_rounds
+        wall = _time.perf_counter() - t0
+        per_round = wall / num_rounds
         for _ in range(num_rounds):
             self._m_round_secs.observe(per_round)
+        if self.perf is not None:
+            # one digest per chunk (the chunk IS one dispatch); the log
+            # keys ride every round of the chunk via _after_round
+            self.perf.observe_round(round_idx, num_rounds, wall)
         return results
 
     def _train_rounds_scan_inner(
@@ -2481,6 +2552,8 @@ class Trainer:
             stacked = shard_round_batches(
                 self.mesh, stack_rounds(round_lists), cfg
             )
+        if self._perf_keep_batch:
+            self._perf_last_batch = stacked
         self._count_steps(num_rounds * steps)
         with tracer.span(
             "dispatch", kind="round_chunk", rounds=num_rounds, steps=steps
@@ -2743,8 +2816,27 @@ class Trainer:
         history: list[RoundResult] = []
         from fedrec_tpu.fed.population import QuorumFailure
 
+        # train.profile traces land inside obs.dir when one is configured
+        # (discoverable next to the artifact trio) instead of the
+        # hardcoded /tmp default; the logdir is pointed to from
+        # metrics.jsonl either way a trace was captured
+        profile_logdir = (
+            str(self._obs_dir / "jax_profile")
+            if cfg.train.profile and self._obs_dir is not None
+            else None
+        )
         try:
-            with profile_if(cfg.train.profile):
+            with profile_if(cfg.train.profile, profile_logdir) as plogdir:
+                if plogdir is not None and self._obs_dir is not None:
+                    import time as _time
+
+                    from fedrec_tpu.obs.perf import append_jsonl_record
+
+                    append_jsonl_record(self._obs_dir / "metrics.jsonl", {
+                        "kind": "profile_trace",
+                        "logdir": plogdir,
+                        "ts": _time.time(),
+                    })
                 round_idx = self.start_round
                 while round_idx < cfg.fed.rounds:
                     # rounds-in-jit: chunks of up to train.rounds_per_scan
@@ -2752,6 +2844,11 @@ class Trainer:
                     # cadence boundaries so the host-side bookkeeping below
                     # sees exactly the rounds it would host-driven
                     chunk = self._round_chunk(round_idx)
+                    if self.perf is not None:
+                        # capture windows open at the dispatch boundary —
+                        # a window intersecting this round/chunk starts a
+                        # jax.profiler trace under obs.dir
+                        self.perf.capture_before_round(round_idx, chunk)
                     # rollback target: the state every client held at
                     # round/chunk entry — one blocking host copy per round
                     # is the price of replayability (same cost profile as
@@ -2780,6 +2877,12 @@ class Trainer:
                         self._commit_population(result.round_idx)
                         self._after_round(result)
                         self._tick_quarantine()
+                    if self.perf is not None:
+                        # the window closes AFTER the round's host-side
+                        # bookkeeping so checkpoint/eval cost is captured
+                        self.perf.capture_after_round(
+                            round_idx + len(results) - 1
+                        )
                     round_idx += len(results)
             if self.snapshots is not None:
                 self.snapshots.wait()  # settle async saves before handing back
@@ -2790,6 +2893,13 @@ class Trainer:
             self._flightrec_on_exception(e)
             raise
         finally:
+            # a still-open perf capture window must stop (and write its
+            # pointer record) on every exit path, before the artifact
+            # dump below appends the final registry snapshot — and the
+            # retained HBM-attribution batch must not outlive the run
+            if self.perf is not None:
+                self.perf.close()
+                self._perf_last_batch = None
             # artifacts on EVERY exit path: a run that died to a cap
             # overflow (or any mid-round error) is exactly the run whose
             # trace/registry state is needed — and the failing round never
@@ -2834,6 +2944,13 @@ class Trainer:
             eps = self._eps_schedule(round_idx + 1)
             self._m_eps.set(eps)
             log["privacy.epsilon_spent"] = round(eps, 6)
+        if self.perf is not None and self.perf.last_round is not None:
+            # the latest round/chunk digest rides the per-round record —
+            # the MFU trend fedrec-obs perf renders (a chunk's rounds all
+            # carry the chunk digest; num_rounds disambiguates in-trace)
+            log.update({
+                k: v for k, v in self.perf.last_round.items() if k != "round"
+            })
         if result.val_metrics:
             # ONE key scheme (val_<metric>), Prometheus-sanitizable as-is —
             # the historical valid_auc/valid_mrr vs val_ndcg@5 mix forced

@@ -20,16 +20,21 @@ import jax
 
 
 @contextlib.contextmanager
-def profile_if(enabled: bool, logdir: str = "/tmp/fedrec_tpu_trace"):
+def profile_if(enabled: bool, logdir: str | None = None):
     """Wrap the block in a ``jax.profiler`` trace when ``enabled``.
 
     Yields the logdir path (the handle on the written trace) when
     enabled, None when not — a no-trace region never looks like it
-    produced an artifact.
+    produced an artifact.  ``logdir=None`` falls back to the historical
+    ``/tmp/fedrec_tpu_trace`` default; the Trainer routes it into
+    ``obs.dir/jax_profile`` when an obs dir is configured (and points to
+    it from ``metrics.jsonl``) so a captured trace is discoverable from
+    the artifact trio instead of hiding in /tmp.
     """
     if not enabled:
         yield None
         return
+    logdir = logdir or "/tmp/fedrec_tpu_trace"
     jax.profiler.start_trace(logdir)
     try:
         yield logdir

@@ -16,7 +16,13 @@
 #      non-finite step on CPU (exit 0 = REPRODUCED),
 #   5. the model-quality smoke (scripts/quality_smoke.sh): sliced-eval
 #      gauges + Quality report section, the store drift-probe leg, and
-#      the forced quality-gate regression failure.
+#      the forced quality-gate regression failure,
+#   6. the perf leg: the training run of (1) carries obs.perf.enabled +
+#      a capture window on round 1 — assert the Perf report section,
+#      `fedrec-obs perf` exit 0, the capture-window trace landing inside
+#      obs.dir with its metrics.jsonl pointer record, then the
+#      perf-regression gate: bank a fresh baseline, pass a clean check,
+#      and prove --demo-regression fails naming the lane.
 #
 #   scripts/obs_smoke.sh     # or: make obs-smoke
 #
@@ -33,11 +39,12 @@ run() {
         XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
 }
 
-echo "== [1/5] 2-round CPU training run (DP + prefetch) =="
+echo "== [1/6] 2-round CPU training run (DP + prefetch) =="
 run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --synthetic --synthetic-train 512 --synthetic-news 128 \
     --mode joint --dp-epsilon 10 \
     --obs-dir "$OUT/train" \
+    --set obs.perf.enabled=1 --set obs.perf.capture_rounds=1 \
     --set data.prefetch_batches=2 \
     --set model.news_dim=32 --set model.num_heads=4 --set model.head_dim=8 \
     --set model.query_dim=16 --set model.bert_hidden=48 \
@@ -46,14 +53,14 @@ run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --set train.eval_protocol=sampled > "$OUT/train.log" 2>&1 \
     || { tail -30 "$OUT/train.log"; exit 1; }
 
-echo "== [2/5] serve_load run =="
+echo "== [2/6] serve_load run =="
 run python benchmarks/serve_load.py --num-news 2000 --his-len 10 \
     --clients 4 --rate 50 --duration 2 --out obs_smoke_serve_load.json \
     --obs-dir "$OUT/serve" > "$OUT/serve.log" 2>&1 \
     || { tail -30 "$OUT/serve.log"; exit 1; }
 rm -f benchmarks/obs_smoke_serve_load.json
 
-echo "== [3/5] artifact assertions =="
+echo "== [3/6] artifact assertions =="
 for d in train serve; do
     for f in metrics.jsonl trace.json prometheus.txt; do
         [ -s "$OUT/$d/$f" ] || { echo "MISSING $OUT/$d/$f"; exit 1; }
@@ -116,7 +123,7 @@ assert any(e["name"] == "fed_round" and e["args"].get("worker") == "0"
 print("  fleet: 2 rounds attributed to worker 0, merged trace valid")
 EOF
 
-echo "== [4/5] forced-NaN flight-recorder round-trip =="
+echo "== [4/6] forced-NaN flight-recorder round-trip =="
 # inf lr: the first optimizer update goes non-finite, the sentry trips,
 # the run must ABORT (nonzero exit) after dumping forensics
 if run python -m fedrec_tpu.cli.run 2 16 1000 --strategy param_avg --clients 8 \
@@ -143,6 +150,42 @@ grep -q "REPRODUCED" "$OUT/replay.log" \
     || { echo "replay verdict missing"; tail -5 "$OUT/replay.log"; exit 1; }
 echo "  forced-NaN: abort + complete flightrec dump + replay REPRODUCED"
 
-echo "== [5/5] model-quality smoke (scripts/quality_smoke.sh) =="
+echo "== [5/6] model-quality smoke (scripts/quality_smoke.sh) =="
 QUALITY_SMOKE_DIR="$OUT/quality" bash scripts/quality_smoke.sh
+
+echo "== [6/6] perf telemetry + perf-regression gate =="
+# the training run of leg 1 carried obs.perf.enabled + capture_rounds=1:
+# the report must render a Perf section, the perf verb must exit 0, and
+# the capture window's jax.profiler trace must have landed in obs.dir
+# with a pointer record in metrics.jsonl
+# (report to a file, then grep: `| grep -q` would close the pipe early
+# and kill the renderer with SIGPIPE under pipefail)
+python -m fedrec_tpu.cli.obs report "$OUT/train" > "$OUT/report_perf.txt"
+grep -q "^## Perf" "$OUT/report_perf.txt" \
+    || { echo "no Perf section in the run report"; exit 1; }
+run python -m fedrec_tpu.cli.obs perf "$OUT/train" > "$OUT/perf.log" \
+    || { echo "fedrec-obs perf failed"; tail -20 "$OUT/perf.log"; exit 1; }
+grep -q "Roofline verdicts" "$OUT/perf.log" \
+    || { echo "perf verb missing the roofline table"; exit 1; }
+ls -d "$OUT"/train/perf_capture_r* > /dev/null 2>&1 \
+    || { echo "no capture-window trace under $OUT/train"; exit 1; }
+grep -q '"kind": "perf_capture"' "$OUT/train/metrics.jsonl" \
+    || { echo "no perf_capture pointer record in metrics.jsonl"; exit 1; }
+echo "  perf: report section + verb + capture window + pointer record ok"
+
+# the gate: bank a fresh seeded baseline, pass a clean re-check, then
+# prove the forced-regression mode exits nonzero NAMING the lane
+run python benchmarks/perf_gate.py --bank --out "$OUT/perf_gate.json" \
+    > "$OUT/perf_gate.log" 2>&1 \
+    || { tail -20 "$OUT/perf_gate.log"; exit 1; }
+run python benchmarks/perf_gate.py --check --out "$OUT/perf_gate.json" \
+    >> "$OUT/perf_gate.log" 2>&1 \
+    || { echo "clean perf-gate check failed"; tail -20 "$OUT/perf_gate.log"; exit 1; }
+if run python benchmarks/perf_gate.py --check --out "$OUT/perf_gate.json" \
+    --demo-regression steps_per_sec >> "$OUT/perf_gate.log" 2>&1; then
+    echo "forced perf regression did NOT fail the gate"; exit 1
+fi
+grep -q "REGRESSION lane steps_per_sec" "$OUT/perf_gate.log" \
+    || { echo "gate failure did not name the lane"; tail -5 "$OUT/perf_gate.log"; exit 1; }
+echo "  perf gate: banked + clean pass + forced regression names the lane"
 echo "OBS_SMOKE=PASS"
