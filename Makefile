@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate agg-scale async-smoke
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -69,6 +69,23 @@ quality-smoke:
 # the banked baseline — the perf analog of quality-gate
 perf-gate:
 	@python benchmarks/perf_gate.py
+
+# aggregation-scale frontier: round time vs cohort size (1k/10k/100k
+# logical clients) for flat vs hierarchical vs async aggregation on the
+# real fedrec_tpu.agg kernels; proves hierarchical round time sub-linear
+# in cohort size at 10k+ and the async quorum cut beating the flat
+# barrier; banks benchmarks/agg_scale.json on first run, then checks
+agg-scale:
+	@python benchmarks/agg_scale.py
+
+# buffered-async smoke: an agg.server commit authority + 4 async workers
+# (one chaos-delayed 4s) — asserts the global commits at quorum 3 while
+# the straggler is still sleeping, the late contribution folds into the
+# NEXT commit (late_folds >= 1), and the delayed worker's marginal
+# commit gate is ~0 in the fleet report (the barrier would have charged
+# it the full straggle)
+async-smoke:
+	@bash scripts/async_smoke.sh
 
 # communication-cost benchmark: measured per-codec wire buffers of the
 # flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);

@@ -58,6 +58,9 @@ verify_serve() {
 verify_acc_dp() { # tuned anchor + eps=10 DP row proven on-chip (r4 #7)
   verify_json_artifact benchmarks/accuracy_dp_tpu.json acc_dp
 }
+verify_agg_scale() { # on-device flat-mean reduce leg of the agg frontier
+  verify_json_artifact benchmarks/agg_scale_tpu.json agg_scale
+}
 
 run_item() { # name timeout cmd...
   local name=$1 tmo=$2; shift 2
@@ -76,7 +79,7 @@ run_item() { # name timeout cmd...
 
 while :; do
   remaining=0
-  for n in bench step_profile serve pallas acc_bf16 acc_dp; do
+  for n in bench step_profile serve pallas acc_bf16 acc_dp agg_scale; do
     [ -e "$MARK/$n" ] || remaining=$((remaining + 1))
   done
   if [ "$remaining" -eq 0 ]; then
@@ -104,6 +107,9 @@ while :; do
     run_item acc_dp 3600 env FEDREC_ACC_INNER=1 \
       FEDREC_DP_ROWS=nodp_tuned,dp_eps10 \
       python benchmarks/accuracy_run.py --leg dp --dp-rounds 32
+    # on-device flat-mean reduce over the 100k-client stack: the
+    # DCN-free upper bound the host agg kernels compare against
+    run_item agg_scale 1200 python benchmarks/agg_scale.py --chip --check
   else
     echo "[watcher] $(date -u +%FT%TZ) chip unreachable; sleeping"
   fi

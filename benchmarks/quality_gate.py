@@ -59,14 +59,23 @@ def allowed_drop(n: float) -> float:
     return max(MIN_DROP, Z / max(n, 1.0) ** 0.5)
 
 
-def run_sliced_eval(perturb_bucket: int | None, seed: int = 0) -> dict:
+def run_sliced_eval(
+    perturb_bucket: int | None, seed: int = 0, async_mode: bool = False,
+) -> dict:
     """The one seeded scenario both bank and check execute: short topic-
     corpus training + a full-pool sliced eval; returns the quality digest.
 
     ``perturb_bucket`` corrupts the token states of every news id hashing
     into that category bucket AT EVAL TIME (training stays identical), so
     exactly the banked scenario runs with one stratum's representations
-    broken — the regression the gate exists to catch."""
+    broken — the regression the gate exists to catch.
+
+    ``async_mode`` re-runs the SAME scenario under ``agg.mode="async"``
+    (quorum 3 of 4, chaos lognormal report latencies so one client per
+    round genuinely arrives late and folds with staleness weighting):
+    the buffered-commit trajectory must stay within the banked sync
+    baseline's noise threshold — the gate's proof that going async did
+    not cost model quality."""
     import tempfile
 
     from fedrec_tpu.config import ExperimentConfig
@@ -102,6 +111,14 @@ def run_sliced_eval(perturb_bucket: int | None, seed: int = 0) -> dict:
     cfg.obs.quality.enabled = True
     cfg.obs.quality.seed = seed
     cfg.obs.quality.hist_len_edges = "4,7"
+    if async_mode:
+        cfg.agg.mode = "async"
+        cfg.agg.quorum = 3
+        cfg.agg.staleness_cap = 2
+        cfg.chaos.enabled = True
+        cfg.chaos.seed = seed
+        cfg.chaos.pop_straggle_ms = 50.0  # latency draw only (no drops):
+        # orders the quorum so the slowest client buffers late each round
 
     old_reg = set_registry(MetricsRegistry())
     try:
@@ -275,7 +292,23 @@ def main() -> int:
         )
         return 2
     baseline = json.loads(out_path.read_text())
-    return check(baseline, digest)
+    rc = check(baseline, digest)
+    if rc != 0 or args.perturb_bucket is not None:
+        return rc
+    # ---- async leg: the same scenario trained under agg.mode=async
+    # (quorum 3/4, lognormal report latencies -> one genuinely late,
+    # staleness-weighted fold per round), checked against the SAME sync
+    # baseline — the buffered commit must not cost model quality beyond
+    # the noise threshold. Skipped for the perturb demonstration (the
+    # forced failure already proved the gate bites).
+    print("quality_gate: async-mode leg (agg.mode=async, quorum 3/4, "
+          "staleness-weighted late folds)")
+    async_digest = run_sliced_eval(None, async_mode=True)
+    print(
+        f"quality_gate[async]: corpus auc "
+        f"{async_digest['corpus'].get('auc', float('nan')):.4f}"
+    )
+    return check(baseline, async_digest)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,36 @@
+"""Round-end aggregation topologies (`agg.mode`).
+
+The flat all-reporting reduce every prior PR shipped is one point in a
+design space with two more:
+
+  * **hierarchical** (:mod:`.hierarchy`) — tiered robust reduce whose
+    critical path is O(log_fanout P) instead of O(P).  With the plain
+    weighted mean the tier tree of (sum(w*x), sum(w)) partials is
+    *algebraically* the flat mean, so that case lowers to the unchanged
+    flat collective and stays bit-identical; per-tier trimming/medians
+    genuinely diverge (docs/DESIGN.md, "Removing the round barrier").
+  * **async** (:mod:`.buffer` + :mod:`.commit`) — buffered quorum
+    commit: the global advances once ``agg.quorum`` contributions land,
+    stragglers fold staleness-weighted into the NEXT commit (dropped
+    past ``agg.staleness_cap``), and the straggler's marginal ``gate_ms``
+    goes to ~0.  :mod:`.server` / :mod:`.worker` are the multi-process
+    deployment (TCP JSON-lines, same wire idiom as the membership
+    service); the Trainer also runs the same commit policy in-process
+    for single-host cohort simulation.
+"""
+
+from fedrec_tpu.agg.buffer import AggBuffer, BufferEntry
+from fedrec_tpu.agg.commit import CommitPolicy, CommitStats, fold_commit, staleness_weight
+from fedrec_tpu.agg.hierarchy import build_tree, tree_critical_path_ms, tree_reduce_np
+
+__all__ = [
+    "AggBuffer",
+    "BufferEntry",
+    "CommitPolicy",
+    "CommitStats",
+    "build_tree",
+    "fold_commit",
+    "staleness_weight",
+    "tree_critical_path_ms",
+    "tree_reduce_np",
+]

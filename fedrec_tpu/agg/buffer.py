@@ -1,0 +1,166 @@
+"""Server-side contribution buffer — the state behind `agg.mode` = "async".
+
+Each entry is one worker's round contribution: a DELTA against the
+global version it trained from (``based_on``), tagged with the
+membership epoch it was produced under, its aggregation weight, and its
+arrival time.  Entries wait here until :mod:`fedrec_tpu.agg.commit`
+folds them — on time at quorum, or staleness-weighted into a later
+commit, or dropped past `agg.staleness_cap`.
+
+Contribution payloads are ORDERED LEAF LISTS (plain ``np.ndarray``
+lists), not structured pytrees: the buffer and the commit fold never
+need the tree structure, only per-leaf arithmetic, so callers flatten
+with their own treedef and unflatten the committed result.  That keeps
+the wire format (npz of positional leaves) and the checkpoint sidecar
+model-agnostic.
+
+The buffer checkpoints beside the model snapshot
+(``agg_buffer.npz`` via :meth:`AggBuffer.state_bytes` /
+:meth:`AggBuffer.load_state`, the same round-tagged sidecar discipline
+as the FedOpt server state): pending late contributions survive a
+restart, and a worker death mid-buffer only costs that worker's pending
+entry — the shrink-then-commit path is pinned in ``tests/test_agg.py``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["AGG_BUFFER_SIDECAR", "AggBuffer", "BufferEntry"]
+
+# the checkpoint sidecar's name beside the model snapshot (the same
+# round-tagged discipline as server_opt_state.msgpack)
+AGG_BUFFER_SIDECAR = "agg_buffer.npz"
+
+_MAGIC = "fedrec-agg-buffer-v1"
+
+
+@dataclass
+class BufferEntry:
+    """One worker's pending round contribution (a delta vs ``based_on``)."""
+
+    worker: str
+    round: int
+    epoch: int                      # membership epoch the delta was produced under
+    based_on: int                   # global version the worker trained from
+    weight: float
+    arrival_ms: float               # simulated/measured arrival latency
+    leaves: list = field(default_factory=list)  # ordered np.ndarray leaf list
+
+
+class AggBuffer:
+    """Epoch-keyed pending-contribution store with sidecar persistence."""
+
+    def __init__(self, epoch: int = 0):
+        self.epoch = int(epoch)
+        self.entries: list[BufferEntry] = []
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def add(self, entry: BufferEntry) -> None:
+        """A worker re-pushing for the same round replaces its stale
+        pending entry (retries after a torn connection must not double
+        its weight)."""
+        self.entries = [
+            e
+            for e in self.entries
+            if not (e.worker == entry.worker and e.round == entry.round)
+        ]
+        self.entries.append(entry)
+
+    def pending_workers(self) -> set[str]:
+        return {e.worker for e in self.entries}
+
+    def take_all(self) -> list[BufferEntry]:
+        out, self.entries = self.entries, []
+        return out
+
+    def advance_epoch(self, epoch: int, drop_dead: set[str] | None = None) -> int:
+        """Membership reformed: adopt the new epoch and drop pending
+        entries from workers that did not survive it (their deltas were
+        produced by a peer that no longer exists — folding them would
+        resurrect a dead member's weight).  Entries from survivors stay
+        buffered and fold with staleness weighting.  Returns the number
+        dropped."""
+        if epoch < self.epoch:
+            raise ValueError(
+                f"membership epoch moved backwards: {self.epoch} -> {epoch}"
+            )
+        self.epoch = int(epoch)
+        if not drop_dead:
+            return 0
+        before = len(self.entries)
+        self.entries = [e for e in self.entries if e.worker not in drop_dead]
+        return before - len(self.entries)
+
+    # ------------------------------------------------------- persistence
+    def state_bytes(self, round_idx: int, version: int) -> bytes:
+        """Round-tagged npz sidecar (one blob, atomically writable)."""
+        meta = {
+            "magic": _MAGIC,
+            "round": int(round_idx),
+            "version": int(version),
+            "epoch": self.epoch,
+            "entries": [
+                {
+                    "worker": e.worker,
+                    "round": e.round,
+                    "epoch": e.epoch,
+                    "based_on": e.based_on,
+                    "weight": float(e.weight),
+                    "arrival_ms": float(e.arrival_ms),
+                    "num_leaves": len(e.leaves),
+                }
+                for e in self.entries
+            ],
+        }
+        arrays = {
+            f"e{i}_leaf{j}": np.asarray(leaf)
+            for i, e in enumerate(self.entries)
+            for j, leaf in enumerate(e.leaves)
+        }
+        buf = io.BytesIO()
+        np.savez(
+            buf, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+            **arrays,
+        )
+        return buf.getvalue()
+
+    @classmethod
+    def load_state(cls, blob: bytes) -> tuple["AggBuffer", int, int]:
+        """Returns ``(buffer, round, version)`` from :meth:`state_bytes`
+        output; raises ``ValueError`` on a foreign or torn blob (the
+        caller decides whether a round-tag mismatch warrants starting
+        empty — late contributions are droppable by design)."""
+        with np.load(io.BytesIO(blob)) as z:
+            try:
+                meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            except (KeyError, json.JSONDecodeError) as e:
+                raise ValueError(f"not an agg-buffer sidecar: {e}") from e
+            if meta.get("magic") != _MAGIC:
+                raise ValueError(
+                    f"not an agg-buffer sidecar (magic={meta.get('magic')!r})"
+                )
+            buf = cls(epoch=meta["epoch"])
+            for i, ent in enumerate(meta["entries"]):
+                leaves = [
+                    np.asarray(z[f"e{i}_leaf{j}"])
+                    for j in range(ent["num_leaves"])
+                ]
+                buf.entries.append(
+                    BufferEntry(
+                        worker=ent["worker"],
+                        round=int(ent["round"]),
+                        epoch=int(ent["epoch"]),
+                        based_on=int(ent["based_on"]),
+                        weight=float(ent["weight"]),
+                        arrival_ms=float(ent["arrival_ms"]),
+                        leaves=leaves,
+                    )
+                )
+        return buf, int(meta["round"]), int(meta["version"])

@@ -1,0 +1,153 @@
+"""Quorum commit + staleness-weighted folding — `agg.mode` = "async".
+
+A commit advances the global from version ``v`` to ``v+1`` by folding a
+set of buffered :class:`~fedrec_tpu.agg.buffer.BufferEntry` deltas:
+
+    staleness(e) = v - e.based_on          (commits behind the global)
+    w~(e)        = e.weight / (1 + staleness(e))
+    global'      = global + reduce_e(w~, delta_e)
+
+where ``reduce`` is the participation-weighted mean for
+``fed.robust.method == "mean"`` (so a zero-staleness all-reporting
+commit is EXACTLY the FedAvg update the flat synchronous path computes
+— FedAvg/FedOpt server state sees identical update semantics, and
+``ServerOptimizer.step(round_start, proposal)`` composes unchanged), or
+:func:`~fedrec_tpu.fed.robust.robust_reduce_tree_np` over the delta
+stacks for robust methods.  The 1/(1+staleness) polynomial decay is the
+FedBuff/FedAsync standard: a late delta was computed against an older
+base, so folding it against the NEW base is an approximation whose
+error grows with staleness — the decay bounds it, and entries past
+`agg.staleness_cap` are dropped outright (``stale_drops``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from fedrec_tpu.agg.buffer import BufferEntry
+from fedrec_tpu.fed.robust import robust_reduce_tree_np
+
+__all__ = ["CommitPolicy", "CommitStats", "fold_commit", "staleness_weight"]
+
+
+@dataclass
+class CommitPolicy:
+    """`agg.quorum` / `agg.staleness_cap` as one value object."""
+
+    quorum: int = 0                # 0 = all-reporting
+    staleness_cap: int = 2
+
+    def quorum_for(self, world: int) -> int:
+        """The effective commit quorum under the CURRENT membership
+        world: a shrink below the configured quorum must not deadlock
+        the commit loop (quorum clamps to the surviving world)."""
+        if world < 1:
+            raise ValueError(f"quorum needs a world >= 1, got {world}")
+        k = self.quorum if self.quorum > 0 else world
+        return max(1, min(k, world))
+
+
+@dataclass
+class CommitStats:
+    version: int = 0               # the version this commit produced
+    folded: int = 0                # entries folded into the commit
+    late_folds: int = 0            # folded entries with staleness > 0
+    stale_drops: int = 0           # entries dropped past the cap
+    mean_staleness: float = 0.0
+    max_staleness: int = 0
+    fold_ms: float = 0.0
+
+
+def staleness_weight(staleness: int) -> float:
+    """FedBuff's polynomial decay: 1/(1+s), s in commits behind."""
+    return 1.0 / (1.0 + max(0, int(staleness)))
+
+
+def fold_commit(
+    base_leaves: list[np.ndarray],
+    entries: list[BufferEntry],
+    version: int,
+    policy: CommitPolicy,
+    method: str = "mean",
+    trim_k: int = 1,
+    clip_norm: float = 10.0,
+) -> tuple[list[np.ndarray], CommitStats]:
+    """Fold ``entries`` into ``base_leaves`` (the version-``version``
+    global, as an ordered leaf list) and return the version-``version+1``
+    leaves plus the commit accounting.  Entries past the staleness cap
+    are dropped, never folded; an all-dropped commit returns the base
+    unchanged at the bumped version (the global advances so the
+    droppers' staleness keeps growing — matching a quorum of on-time
+    entries arriving with nothing foldable)."""
+    t0 = time.monotonic()
+    stats = CommitStats(version=version + 1)
+    fold: list[BufferEntry] = []
+    stales: list[int] = []
+    for e in entries:
+        s = version - e.based_on
+        if s < 0:
+            raise ValueError(
+                f"entry from {e.worker!r} based_on={e.based_on} is ahead of "
+                f"the global version {version}"
+            )
+        if s > policy.staleness_cap:
+            stats.stale_drops += 1
+            continue
+        fold.append(e)
+        stales.append(s)
+    if not fold:
+        stats.fold_ms = (time.monotonic() - t0) * 1e3
+        return [np.asarray(x) for x in base_leaves], stats
+
+    w = np.asarray(
+        [e.weight * staleness_weight(s) for e, s in zip(fold, stales)],
+        np.float64,
+    )
+    stats.folded = len(fold)
+    stats.late_folds = sum(1 for s in stales if s > 0)
+    stats.mean_staleness = float(np.mean(stales))
+    stats.max_staleness = int(max(stales))
+
+    stacks = [
+        np.stack([np.asarray(e.leaves[j], np.float64) for e in fold], axis=0)
+        for j in range(len(base_leaves))
+    ]
+    total = float(np.sum(w * (w > 0)))
+    if method == "mean" or total == 0.0:
+        if total == 0.0:
+            delta = [np.zeros_like(np.asarray(b, np.float64)) for b in base_leaves]
+        else:
+            wmask = w > 0
+            delta = [
+                np.einsum(
+                    "p,p...->...",
+                    w * wmask,
+                    np.where(
+                        wmask.reshape((-1,) + (1,) * (s.ndim - 1)), s, 0.0
+                    ),
+                )
+                / total
+                for s in stacks
+            ]
+    else:
+        # robust methods reduce the delta stacks directly; fallback 0
+        # (an all-non-finite coordinate leaves the global untouched)
+        reduced = robust_reduce_tree_np(
+            stacks,
+            w,
+            method,
+            trim_k=trim_k,
+            clip_norm=clip_norm,
+            fallback_tree=[np.zeros_like(np.asarray(b)) for b in base_leaves],
+        )
+        delta = list(jax.tree_util.tree_flatten(reduced)[0])
+    out = [
+        np.asarray(b, np.float64) + d for b, d in zip(base_leaves, delta)
+    ]
+    out = [o.astype(np.asarray(b).dtype) for o, b in zip(out, base_leaves)]
+    stats.fold_ms = (time.monotonic() - t0) * 1e3
+    return out, stats

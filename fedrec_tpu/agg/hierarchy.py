@@ -1,0 +1,213 @@
+"""Hierarchical (tiered) robust reduction — `agg.mode` = "hierarchical".
+
+The flat reduce applies ``fed.robust`` once over all P contributions;
+here the P contributions are grouped into ``agg.tree_fanout``-wide tiers,
+each tier is pre-aggregated with the SAME robust method, and the tier
+outputs are reduced up the tree.  Tier groups at one level are
+independent, so on a real deployment they run on distinct hosts in
+parallel and the round's reduce cost is the per-level MAX group time
+summed over the O(log_fanout P) levels — not the O(P) flat sweep
+(:func:`tree_critical_path_ms` is that accounting, and
+``benchmarks/agg_scale.py`` banks the measured frontier).
+
+Two semantic regimes, pinned in ``tests/test_agg.py``:
+
+  * ``method == "mean"`` — each tier carries (sum(w*x), sum(w)) partial
+    sums and ONE divide happens at the root.  A tree of partial sums is
+    *algebraically* the flat weighted mean, so the Trainer never routes
+    mean through this module at all: hierarchical+mean lowers to the
+    unchanged flat collective and is bit-identical by construction
+    (float summation ORDER is the implementation's right; the partial
+    sums here are f64, matching :func:`robust_reduce_np`'s mean).
+  * any other method — trimming/median/clip act on tier PRE-AGGREGATES
+    above the leaf level, not on raw cohort members, so the trajectory
+    genuinely diverges from the flat robust reduce (a tier of honest
+    clients can absorb a poisoned member before the next tier sees it).
+    The divergence is bounded-delta pinned and documented in
+    docs/DESIGN.md.
+
+Topology is rebuilt from the CURRENT member count on every call
+(:func:`build_tree` is deterministic in (count, fanout)), so when a
+membership epoch shrinks or a peer rejoins the tree reforms with the
+new world — there is no cached topology to invalidate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from fedrec_tpu.fed.robust import robust_reduce_tree_np, validate_robust_method
+
+__all__ = ["build_tree", "tree_critical_path_ms", "tree_reduce_np"]
+
+
+def build_tree(count: int, fanout: int) -> list[list[list[int]]]:
+    """Deterministic reduce-tree plan over ``count`` rank-ordered members.
+
+    Returns one list of groups per level; each group is a list of indices
+    into the PREVIOUS level's outputs (level 0 indexes the raw members).
+    Contiguous rank-order grouping keeps co-located ranks (same host's
+    processes are adjacent ranks) in the same tier, which is what makes
+    the per-host pre-aggregate local.  ``count`` <= ``fanout`` is the
+    degenerate single-group tree — one level, identical to flat.
+    """
+    if count < 1:
+        raise ValueError(f"reduce tree needs >= 1 member, got {count}")
+    if fanout < 2:
+        raise ValueError(f"agg.tree_fanout must be >= 2, got {fanout}")
+    levels: list[list[list[int]]] = []
+    cur = count
+    while cur > 1:
+        groups = [
+            list(range(i, min(i + fanout, cur))) for i in range(0, cur, fanout)
+        ]
+        levels.append(groups)
+        cur = len(groups)
+    if not levels:  # count == 1: a single trivial level keeps callers uniform
+        levels.append([[0]])
+    return levels
+
+
+def tree_critical_path_ms(stats: dict) -> float:
+    """The parallel-deployment cost of a measured reduce: per level the
+    groups run concurrently on distinct hosts, so the level costs its
+    slowest group and the tree costs the sum of levels."""
+    return float(sum(lv["max_group_ms"] for lv in stats.get("levels", [])))
+
+
+def tree_reduce_np(
+    gathered_tree: Any,
+    weights: np.ndarray,
+    fanout: int,
+    method: str,
+    trim_k: int = 1,
+    clip_norm: float = 10.0,
+    fallback_tree: Any = None,
+    stats: dict | None = None,
+) -> Any:
+    """Tiered numpy robust reduction: every leaf of ``gathered_tree`` is a
+    (P, ...) stack; the P contributions reduce up a
+    :func:`build_tree`-planned tree, each group via the SAME
+    ``fed.robust`` reducer the flat path uses
+    (:func:`~fedrec_tpu.fed.robust.robust_reduce_tree_np`), so robust
+    semantics compose per tier rather than being reimplemented here.
+
+    A tier output's weight at the next level is its group's summed
+    weight: for "mean" this makes the tree algebraically the flat
+    weighted mean (pinned), for robust methods it keeps participation
+    (weight > 0) flowing upward.  An all-zero-weight group contributes
+    weight 0 and its (fallback) value is masked out one level up —
+    matching the flat reduce's treatment of non-participants.
+
+    ``stats`` (out-param) records per-level group counts and timings;
+    :func:`tree_critical_path_ms` turns them into the parallel cost.
+    """
+    validate_robust_method(method)
+    leaves, treedef = jax.tree_util.tree_flatten(gathered_tree)
+    stacks = [np.asarray(leaf, np.float64) for leaf in leaves]
+    count = stacks[0].shape[0]
+    w = np.asarray(weights, np.float64)
+    if w.shape[0] != count:
+        raise ValueError(f"weights {w.shape} do not match stack P={count}")
+    fb_leaves: list = [None] * len(stacks)
+    if fallback_tree is not None:
+        fb_leaves = jax.tree_util.tree_flatten(fallback_tree)[0]
+    if stats is not None:
+        stats.setdefault("levels", [])
+        stats["members"] = int(count)
+        stats["fanout"] = int(fanout)
+
+    if method == "mean":
+        out = _mean_tree(stacks, w, fanout, stats)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    for groups in build_tree(count, fanout):
+        next_stacks: list[list[np.ndarray]] = [[] for _ in stacks]
+        next_w = np.zeros((len(groups),), np.float64)
+        group_ms: list[float] = []
+        for gi, idxs in enumerate(groups):
+            t0 = time.monotonic()
+            sub_w = w[idxs]
+            next_w[gi] = float(np.sum(sub_w * (sub_w > 0)))
+            if next_w[gi] == 0.0:
+                # no participant in the tier: carry the fallback (masked
+                # out by weight 0 at the next level)
+                for li, fb in enumerate(fb_leaves):
+                    cell = (
+                        np.asarray(fb, np.float64)
+                        if fb is not None
+                        else np.zeros(stacks[li].shape[1:], np.float64)
+                    )
+                    next_stacks[li].append(cell)
+                group_ms.append((time.monotonic() - t0) * 1e3)
+                continue
+            sub_tree = jax.tree_util.tree_unflatten(
+                treedef, [s[idxs] for s in stacks]
+            )
+            reduced = robust_reduce_tree_np(
+                sub_tree,
+                sub_w,
+                method,
+                trim_k=trim_k,
+                clip_norm=clip_norm,
+                fallback_tree=fallback_tree,
+            )
+            for li, leaf in enumerate(jax.tree_util.tree_flatten(reduced)[0]):
+                next_stacks[li].append(np.asarray(leaf, np.float64))
+            group_ms.append((time.monotonic() - t0) * 1e3)
+        stacks = [np.stack(cells, axis=0) for cells in next_stacks]
+        w = next_w
+        if stats is not None:
+            stats["levels"].append(
+                {
+                    "groups": len(groups),
+                    "max_group_ms": max(group_ms) if group_ms else 0.0,
+                    "total_ms": float(sum(group_ms)),
+                }
+            )
+    out = [s[0] for s in stacks]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _mean_tree(
+    stacks: list[np.ndarray], w: np.ndarray, fanout: int, stats: dict | None
+) -> list[np.ndarray]:
+    """The partial-sum lowering: tiers carry (sum(w*x), sum(w)) and the
+    ONE divide happens at the root — algebraically the flat weighted
+    mean (``tests/test_agg.py`` pins exactness on binary-representable
+    data and allclose in general)."""
+    total = float(np.sum(w * (w > 0)))
+    if total == 0:
+        raise ValueError("mean reduction needs >= 1 participant")
+    wmask = w > 0
+    partials = [
+        np.einsum(
+            "p,p...->p...", w * wmask, np.where(
+                wmask.reshape((-1,) + (1,) * (s.ndim - 1)), s, 0.0
+            )
+        )
+        for s in stacks
+    ]
+    count = partials[0].shape[0]
+    for groups in build_tree(count, fanout):
+        group_ms: list[float] = []
+        next_partials: list[list[np.ndarray]] = [[] for _ in partials]
+        for idxs in groups:
+            t0 = time.monotonic()
+            for li, p in enumerate(partials):
+                next_partials[li].append(p[idxs].sum(axis=0))
+            group_ms.append((time.monotonic() - t0) * 1e3)
+        partials = [np.stack(cells, axis=0) for cells in next_partials]
+        if stats is not None:
+            stats["levels"].append(
+                {
+                    "groups": len(groups),
+                    "max_group_ms": max(group_ms) if group_ms else 0.0,
+                    "total_ms": float(sum(group_ms)),
+                }
+            )
+    return [p[0] / total for p in partials]

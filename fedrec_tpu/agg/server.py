@@ -1,0 +1,431 @@
+"""Standalone buffered-aggregation service — the async deployment's
+commit authority (`agg.mode` = "async" across processes).
+
+The synchronous deployments aggregate inside an all-process collective,
+which is exactly the barrier async mode removes — so async workers do
+NOT form a collective world at all.  Each runs a single-process Trainer
+and speaks to this service over the fleet's TCP JSON-lines wire idiom
+(:func:`~fedrec_tpu.obs.fleet.serve_json_line`, the same exchange the
+membership service and telemetry collector use):
+
+    hello  {worker, epoch}                 -> {version, quorum, have_global}
+    init   {worker, payload}               -> {version}   (first caller seeds v0)
+    push   {worker, round, epoch, based_on,
+            weight, payload}               -> {version, committed}
+    global {since}                         -> {version[, payload]}
+    status {}                              -> commit/gate/buffer accounting
+
+Payloads are base64 npz blobs of ORDERED leaf lists (the buffer's
+model-agnostic contract).  A push lands in the :class:`AggBuffer`; once
+``agg.quorum`` distinct workers are pending the commit fires through
+:func:`~fedrec_tpu.agg.commit.fold_commit` — stragglers' later pushes
+fold staleness-weighted into the NEXT commit.
+
+Gate accounting (the before/after panel's "after" side): per commit the
+quorum-CLOSING arrival is charged ``t_K - t_{K-1}`` — the marginal
+delay it inflicted on the commit, the async analogue of the barrier
+deployment's ``gate_ms`` attribution — and every other worker is
+charged 0.  A chaos-delayed worker never closes a quorum, so its gate
+pins to ~0 (``scripts/async_smoke.sh`` asserts exactly this).
+
+Buffer state persists to ``--state-dir`` after every state change (the
+checkpoint sidecar discipline), so pending late contributions survive a
+service restart.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+
+from fedrec_tpu.agg.buffer import AggBuffer, BufferEntry
+from fedrec_tpu.agg.commit import CommitPolicy, fold_commit
+
+__all__ = ["AggServer", "decode_leaves", "encode_leaves", "main"]
+
+
+def encode_leaves(leaves: list[np.ndarray]) -> str:
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)})
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def decode_leaves(payload: str) -> list[np.ndarray]:
+    with np.load(io.BytesIO(base64.b64decode(payload))) as z:
+        return [np.asarray(z[f"leaf{i}"]) for i in range(len(z.files))]
+
+
+class AggServer:
+    """The commit authority: global leaves + buffer + quorum policy."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        policy: CommitPolicy | None = None,
+        method: str = "mean",
+        trim_k: int = 1,
+        clip_norm: float = 10.0,
+        world: int = 0,
+        obs_dir: str | None = None,
+        state_dir: str | None = None,
+    ):
+        self.host = host
+        self.port = port
+        self.policy = policy or CommitPolicy()
+        self.method = method
+        self.trim_k = trim_k
+        self.clip_norm = clip_norm
+        self.world = int(world)
+        self.obs_dir = obs_dir
+        self.state_dir = state_dir
+        self.version = 0
+        self.global_leaves: list[np.ndarray] | None = None
+        self.buffer = AggBuffer()
+        self.commit_log: list[dict] = []
+        self._arrival: dict[str, float] = {}   # pending worker -> arrival time
+        self._gate_ms: dict[str, float] = {}   # worker -> LAST commit gate
+        self._workers: set[str] = set()
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._instrument()
+        self._restore()
+
+    # --------------------------------------------------------------- obs
+    def _instrument(self) -> None:
+        from fedrec_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._m_commits = reg.counter(
+            "agg.commits_total",
+            "async global commits the service performed (version bumps)",
+        )
+        self._m_late = reg.counter(
+            "agg.late_folds_total",
+            "buffered contributions folded with staleness > 0 "
+            "(the straggler path working as designed)",
+        )
+        self._m_stale = reg.counter(
+            "agg.stale_drops_total",
+            "buffered contributions dropped past agg.staleness_cap",
+        )
+        self._g_staleness = reg.gauge(
+            "agg.staleness",
+            "mean staleness (commits behind) of the last commit's folds",
+        )
+        self._g_quorum_wait = reg.gauge(
+            "agg.quorum_wait_ms",
+            "first-arrival -> quorum-close wall time of the last commit "
+            "(what the commit actually waited, vs the barrier's full round)",
+        )
+        self._g_pending = reg.gauge(
+            "agg.buffer_pending",
+            "contributions sitting in the async buffer right now",
+        )
+        self._g_gate = reg.gauge(
+            "agg.worker_gate_ms",
+            "marginal commit delay charged to this worker at its last "
+            "commit (the async analogue of critical-path gate_ms; a "
+            "straggler that never closes a quorum stays ~0)",
+            labels=("worker",),
+        )
+
+    def dump_obs(self) -> None:
+        if not self.obs_dir:
+            return
+        from pathlib import Path
+
+        from fedrec_tpu.obs import dump_artifacts, rotate_jsonl
+
+        try:
+            rotate_jsonl(Path(self.obs_dir) / "metrics.jsonl", 64.0)
+            dump_artifacts(self.obs_dir)
+        except OSError:
+            pass  # a full disk must not take the commit authority down
+
+    # ------------------------------------------------------- persistence
+    def _state_path(self):
+        from pathlib import Path
+
+        return Path(self.state_dir) / "agg_buffer.npz" if self.state_dir else None
+
+    def _persist(self) -> None:
+        path = self._state_path()
+        if path is None:
+            return
+        from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_bytes(path, self.buffer.state_bytes(0, self.version))
+        except OSError:
+            pass
+
+    def _restore(self) -> None:
+        path = self._state_path()
+        if path is None or not path.exists():
+            return
+        try:
+            self.buffer, _, self.version = AggBuffer.load_state(
+                path.read_bytes()
+            )
+            print(
+                f"[aggserver] restored {len(self.buffer)} pending "
+                f"contribution(s) at version {self.version}",
+                flush=True,
+            )
+        except (ValueError, OSError) as e:
+            print(f"[aggserver] ignoring unreadable buffer sidecar: {e}",
+                  flush=True)
+
+    # ----------------------------------------------------------- serving
+    def start(self) -> "AggServer":
+        srv = socket.create_server((self.host, self.port))
+        srv.settimeout(0.5)
+        self._srv = srv
+        self.port = srv.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads = [t]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._persist()
+        self.dump_obs()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        from fedrec_tpu.obs.fleet import serve_json_line
+
+        assert self._srv is not None
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=serve_json_line, args=(conn, self.handle),
+                kwargs={"timeout_s": 120.0, "recv_bytes": 1 << 22},
+                daemon=True,
+            ).start()
+
+    # ---------------------------------------------------------- handlers
+    def handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd == "hello":
+            return self._hello(str(req["worker"]), int(req.get("epoch", 0)))
+        if cmd == "init":
+            return self._init(str(req["worker"]), req["payload"])
+        if cmd == "push":
+            return self._push(req)
+        if cmd == "global":
+            return self._global(int(req.get("since", -1)))
+        if cmd == "status":
+            return self.status()
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    def _hello(self, worker: str, epoch: int) -> dict:
+        with self._lock:
+            self._workers.add(worker)
+            world = self.world or len(self._workers)
+            if epoch > self.buffer.epoch:
+                self.buffer.advance_epoch(epoch)
+            return {
+                "version": self.version,
+                "quorum": self.policy.quorum_for(world),
+                "have_global": self.global_leaves is not None,
+            }
+
+    def _init(self, worker: str, payload: str) -> dict:
+        with self._lock:
+            if self.global_leaves is None:
+                self.global_leaves = decode_leaves(payload)
+                print(f"[aggserver] v0 global seeded by {worker!r}", flush=True)
+            return {"version": self.version}
+
+    def _push(self, req: dict) -> dict:
+        worker = str(req["worker"])
+        with self._lock:
+            if self.global_leaves is None:
+                return {"error": "push before init: no v0 global"}
+            entry = BufferEntry(
+                worker=worker,
+                round=int(req["round"]),
+                epoch=int(req.get("epoch", self.buffer.epoch)),
+                based_on=int(req["based_on"]),
+                weight=float(req.get("weight", 1.0)),
+                arrival_ms=time.monotonic() * 1e3,
+                leaves=decode_leaves(req["payload"]),
+            )
+            self.buffer.add(entry)
+            self._workers.add(worker)
+            self._arrival[worker] = entry.arrival_ms
+            committed = self._maybe_commit()
+            self._g_pending.set(float(len(self.buffer)))
+            self._persist()
+            return {"version": self.version, "committed": committed}
+
+    def _maybe_commit(self) -> bool:
+        """Caller holds the lock.  Fires when quorum-many DISTINCT
+        workers are pending; folds EVERYTHING buffered (on-time + late)."""
+        world = self.world or max(len(self._workers), 1)
+        k = self.policy.quorum_for(world)
+        pending = self.buffer.pending_workers()
+        if len(pending) < k:
+            return False
+        entries = self.buffer.take_all()
+        assert self.global_leaves is not None
+        self.global_leaves, stats = fold_commit(
+            self.global_leaves, entries, self.version, self.policy,
+            method=self.method, trim_k=self.trim_k,
+            clip_norm=self.clip_norm,
+        )
+        self.version = stats.version
+        # gate attribution: the quorum-closing arrival is charged its
+        # marginal delay over the runner-up; everyone else 0
+        arrivals = sorted(
+            (self._arrival[w] for w in pending if w in self._arrival)
+        )
+        closer = max(
+            (w for w in pending if w in self._arrival),
+            key=lambda w: self._arrival[w],
+        )
+        gate = arrivals[-1] - arrivals[-2] if len(arrivals) > 1 else 0.0
+        wait = arrivals[-1] - arrivals[0] if len(arrivals) > 1 else 0.0
+        for w in pending:
+            g = gate if w == closer else 0.0
+            self._gate_ms[w] = g
+            self._g_gate.set(g, worker=w)
+        self._arrival.clear()
+        self._m_commits.inc()
+        self._m_late.inc(float(stats.late_folds))
+        self._m_stale.inc(float(stats.stale_drops))
+        self._g_staleness.set(stats.mean_staleness)
+        self._g_quorum_wait.set(wait)
+        self.commit_log.append(
+            {
+                "version": stats.version,
+                "folded": stats.folded,
+                "late_folds": stats.late_folds,
+                "stale_drops": stats.stale_drops,
+                "mean_staleness": stats.mean_staleness,
+                "quorum": len(pending),
+                "quorum_wait_ms": wait,
+                "closer": closer,
+                "gate_ms": gate,
+            }
+        )
+        self.dump_obs()
+        return True
+
+    def _global(self, since: int) -> dict:
+        with self._lock:
+            if self.global_leaves is None:
+                return {"version": -1}
+            out: dict = {"version": self.version}
+            if self.version > since:
+                out["payload"] = encode_leaves(self.global_leaves)
+            return out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "version": self.version,
+                "pending": len(self.buffer),
+                "pending_workers": sorted(self.buffer.pending_workers()),
+                "workers": sorted(self._workers),
+                "epoch": self.buffer.epoch,
+                "commits": list(self.commit_log),
+                "gate_ms": dict(self._gate_ms),
+            }
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone commit authority (the async smoke's control plane)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="fedrec buffered-aggregation (async commit) service"
+    )
+    parser.add_argument("address", metavar="HOST:PORT")
+    parser.add_argument("--quorum", type=int, default=0,
+                        help="commit once this many distinct workers are "
+                             "pending (agg.quorum; 0 = all-reporting)")
+    parser.add_argument("--staleness-cap", type=int, default=2,
+                        help="drop buffered updates older than this many "
+                             "commits (agg.staleness_cap)")
+    parser.add_argument("--world", type=int, default=0,
+                        help="expected worker count (0 = learn from hellos)")
+    parser.add_argument("--method", default="mean",
+                        help="fed.robust.method applied to the delta fold")
+    parser.add_argument("--obs-dir", default=None,
+                        help="write the service's obs artifact trio here — "
+                             "name it worker_aggserver under the fleet obs "
+                             "root so fedrec-obs fleet merges the commit/"
+                             "gate story")
+    parser.add_argument("--state-dir", default=None,
+                        help="persist the pending buffer here across "
+                             "restarts (agg_buffer.npz)")
+    args = parser.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    if args.obs_dir:
+        from fedrec_tpu.obs.fleet import set_fleet_identity
+
+        set_fleet_identity(worker="aggserver")
+    server = AggServer(
+        host=host, port=int(port),
+        policy=CommitPolicy(quorum=args.quorum,
+                            staleness_cap=args.staleness_cap),
+        method=args.method, world=args.world,
+        obs_dir=args.obs_dir, state_dir=args.state_dir,
+    ).start()
+    print(f"[aggserver] serving on {server.address}", flush=True)
+
+    import signal
+
+    def _term(signum, frame):  # noqa: ARG001 — signal handler signature
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError):
+        pass  # not the main thread / unsupported platform: best effort
+    try:
+        last = None
+        while True:
+            time.sleep(2)
+            status = server.status() if args.obs_dir else None
+            if args.obs_dir and status != last:
+                server.dump_obs()
+                last = status
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

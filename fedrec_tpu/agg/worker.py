@@ -1,0 +1,170 @@
+"""Async worker loop — one process's side of the buffered-commit wire.
+
+An async worker is a SINGLE-process Trainer (no collective world — the
+all-process barrier is exactly what async mode removes) that, per
+round:
+
+  1. trains its local round (``Trainer.train_round_recovering``),
+  2. computes its contribution DELTA against the global version it
+     trained from,
+  3. pushes the delta to the :mod:`~fedrec_tpu.agg.server` commit
+     authority (after the scripted chaos delay, when this worker is the
+     smoke's straggler — ``chaos.straggle_ms`` is the host-driven
+     straggle knob and sleeps here, at the push boundary),
+  4. polls for a NEWER committed global (bounded wait — on timeout the
+     worker proceeds from its own params and its next push simply
+     carries higher staleness; that is the async contract, not an
+     error) and adopts it via ``set_global_params``.
+
+Because every worker seeds identically (same config, same
+``train.seed``), the first worker's ``init`` push IS the version-0
+global; the others verify against it by adopting it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+__all__ = ["run_async_worker"]
+
+
+def _flatten_params(trainer) -> tuple[list[np.ndarray], object]:
+    user_params, news_params = trainer._client0_params()
+    leaves, treedef = jax.tree_util.tree_flatten((user_params, news_params))
+    return [np.asarray(x) for x in leaves], treedef
+
+
+def run_async_worker(
+    trainer,
+    server: str,
+    worker_id: str,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.2,
+    global_wait_s: float = 20.0,
+) -> list:
+    """Drive ``trainer`` for its configured rounds against the commit
+    authority at ``server`` ("HOST:PORT").  Returns the round history
+    (same shape as ``Trainer.run``)."""
+    from fedrec_tpu.agg.server import decode_leaves, encode_leaves
+    from fedrec_tpu.obs.fleet import request_json_line
+
+    cfg = trainer.cfg
+    host, port_s = server.rsplit(":", 1)
+    port = int(port_s)
+
+    def rpc(req: dict) -> dict:
+        return request_json_line(host, port, req, timeout_s=timeout_s)
+
+    g_version = trainer.registry.gauge(
+        "agg.global_version",
+        "committed global version this worker last adopted",
+    )
+    g_staleness = trainer.registry.gauge(
+        "agg.staleness",
+        "commits the global had advanced past this worker's base when it "
+        "pushed (worker-side view)",
+    )
+    c_pushes = trainer.registry.counter(
+        "agg.pushes_total", "contribution deltas this worker pushed"
+    )
+
+    epoch = 0
+    hello = rpc({"cmd": "hello", "worker": worker_id, "epoch": epoch})
+    version = int(hello["version"])
+    leaves, treedef = _flatten_params(trainer)
+    if not hello.get("have_global"):
+        rpc({
+            "cmd": "init", "worker": worker_id,
+            "payload": encode_leaves(leaves),
+        })
+    resp = rpc({"cmd": "global", "since": -1})
+    if "payload" in resp:
+        base = decode_leaves(resp["payload"])
+        version = int(resp["version"])
+        _adopt(trainer, treedef, base)
+    else:
+        base = leaves
+
+    straggle_s = (
+        cfg.chaos.straggle_ms / 1e3
+        if cfg.chaos.enabled and cfg.chaos.straggle_ms > 0
+        else 0.0
+    )
+    history = []
+    for round_idx in range(trainer.start_round, cfg.fed.rounds):
+        # train_round_recovering already commits the population schedule
+        # and ticks quarantine; _after_round is the run()-loop half
+        # (logging, cadence snapshots, fleet push) we replicate here
+        result = trainer.train_round_recovering(round_idx)
+        history.append(result)
+        trainer._after_round(result)
+
+        after, _ = _flatten_params(trainer)
+        delta = [a - b for a, b in zip(after, base)]
+        if straggle_s > 0:
+            print(
+                f"[agg-worker {worker_id}] straggling "
+                f"{straggle_s:.1f}s before the round-{round_idx} push",
+                flush=True,
+            )
+            time.sleep(straggle_s)
+        resp = rpc({
+            "cmd": "push", "worker": worker_id, "round": round_idx,
+            "epoch": epoch, "based_on": version, "weight": 1.0,
+            "payload": encode_leaves(delta),
+        })
+        c_pushes.inc()
+        g_staleness.set(float(max(0, int(resp["version"]) - version)))
+
+        # bounded wait for a commit NEWER than our base; timing out is
+        # the async contract (train on, push staler next round)
+        deadline = time.monotonic() + global_wait_s
+        new_version, payload = version, None
+        while time.monotonic() < deadline:
+            resp = rpc({"cmd": "global", "since": version})
+            if "payload" in resp:
+                new_version, payload = int(resp["version"]), resp["payload"]
+                break
+            time.sleep(poll_s)
+        if payload is not None:
+            base = decode_leaves(payload)
+            version = new_version
+            _adopt(trainer, treedef, base)
+            g_version.set(float(version))
+        else:
+            base = after
+            print(
+                f"[agg-worker {worker_id}] no commit within "
+                f"{global_wait_s:.0f}s after round {round_idx}; "
+                "proceeding stale",
+                flush=True,
+            )
+
+    # the run()-loop's exit-path bookkeeping: artifacts + final push
+    if trainer._obs_dir is not None:
+        try:
+            from fedrec_tpu.obs import dump_artifacts
+
+            dump_artifacts(
+                trainer._obs_dir, registry=trainer.registry,
+                tracer=trainer.tracer,
+            )
+        except OSError as e:
+            print(f"[agg-worker {worker_id}] could not write obs "
+                  f"artifacts: {e}", flush=True)
+    if trainer.fleet_pusher is not None:
+        trainer.fleet_pusher.push(final=True)
+    try:
+        trainer.logger.finish()
+    except Exception as e:  # noqa: BLE001 — a flush error must not fail the run
+        print(f"[agg-worker {worker_id}] logger.finish failed: {e}",
+              flush=True)
+    return history
+
+
+def _adopt(trainer, treedef, leaves: list[np.ndarray]) -> None:
+    user_params, news_params = jax.tree_util.tree_unflatten(treedef, leaves)
+    trainer.set_global_params(user_params, news_params)

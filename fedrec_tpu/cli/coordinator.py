@@ -422,6 +422,10 @@ def main(argv: list[str] | None = None) -> int:
         ),
         membership=membership,
         epoch=assignment.epoch if assignment is not None else 0,
+        # agg.mode=hierarchical: per-host robust pre-aggregate + tiered
+        # cross-host reduce (mean deliberately lowers to the flat
+        # collective — see aggregate_from_hosts)
+        agg=cfg.agg,
     )
     apply_process_sharding(cfg, rt, args.server_trains)
 

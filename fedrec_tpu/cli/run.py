@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs-dir", default=None,
                    help="write observability artifacts here (shorthand for "
                         "--set obs.dir=...); render with fedrec-obs report")
+    p.add_argument("--agg-server", default=None, metavar="HOST:PORT",
+                   help="async federation (agg.mode=async across processes): "
+                        "drive rounds against this fedrec_tpu.agg.server "
+                        "commit authority instead of a collective world")
+    p.add_argument("--worker-id", default=None,
+                   help="this worker's name on the agg server / in the "
+                        "fleet report (required with --agg-server)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="SECTION.KEY=VALUE")
     return p
@@ -146,8 +153,30 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
 
-    trainer = Trainer(cfg, data, token_states)
-    history = trainer.run()
+    if args.agg_server:
+        if not args.worker_id:
+            print("[run] --agg-server requires --worker-id", file=sys.stderr)
+            return 2
+        # async deployment: the round barrier is the agg server's quorum
+        # commit, not a collective. The TRAINER stays in flat mode (its
+        # local 1-client sync is the identity; the buffered commit lives
+        # server-side) — agg.mode="async" is the IN-process simulation
+        # knob for cohort deployments, not this wire path.
+        from fedrec_tpu.obs.fleet import set_fleet_identity
+
+        set_fleet_identity(worker=str(args.worker_id))
+        if cfg.obs.dir:
+            # the worker_* layout `fedrec-obs fleet` merges (same
+            # discipline as the coordinator CLI)
+            cfg.obs.dir = str(Path(cfg.obs.dir) / f"worker_{args.worker_id}")
+        trainer = Trainer(cfg, data, token_states)
+
+        from fedrec_tpu.agg.worker import run_async_worker
+
+        history = run_async_worker(trainer, args.agg_server, args.worker_id)
+    else:
+        trainer = Trainer(cfg, data, token_states)
+        history = trainer.run()
     if history and history[-1].val_metrics:
         m = history[-1].val_metrics
         print(

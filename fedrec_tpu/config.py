@@ -664,6 +664,51 @@ class ChaosConfig:
 
 
 @dataclass
+class AggConfig:
+    """Round-end aggregation topology (``fedrec_tpu.agg``).
+
+    ``mode`` selects how the cohort's contributions become the next
+    global:
+
+      * "flat"         — the all-reporting single reduce (the default;
+                         every prior PR's behavior, bit-for-bit).
+      * "hierarchical" — tiered reduce: cohort contributions are grouped
+                         into ``tree_fanout``-wide tiers, each tier
+                         pre-aggregated with the ``fed.robust`` method,
+                         and the tier outputs reduced up a tree whose
+                         critical path is O(log_fanout P) instead of
+                         O(P).  With ``fed.robust.method="mean"`` the
+                         tree of (sum(w*x), sum(w)) partials with ONE
+                         final divide is *algebraically* the flat
+                         weighted mean, so the mode lowers to the
+                         unchanged flat reduce and stays bit-identical
+                         (pinned in tests/test_agg.py); any other robust
+                         method trims/medians per tier and genuinely
+                         diverges from the flat trajectory (documented
+                         in docs/DESIGN.md, bounded-delta pinned).
+      * "async"        — buffered quorum commit (``agg/buffer.py`` +
+                         ``agg/commit.py``): the global commits once
+                         ``quorum`` contributions arrive; late
+                         contributions are staleness-weighted by
+                         1/(1+staleness) into the NEXT commit and
+                         dropped once staleness exceeds
+                         ``staleness_cap`` commits.  The round barrier
+                         disappears — a straggler's marginal ``gate_ms``
+                         goes to ~0 (scripts/async_smoke.sh).
+
+    ``quorum`` = 0 means all-reporting (async mode then still commits
+    per round, but without early-commit savings).  The buffer state is
+    checkpointed beside the model snapshot so pending late contributions
+    survive a restart.
+    """
+
+    mode: str = "flat"                 # "flat" | "hierarchical" | "async"
+    quorum: int = 0                    # async commit quorum K; 0 = all-reporting
+    staleness_cap: int = 2             # drop buffered updates older than this (commits)
+    tree_fanout: int = 2               # hierarchical tier width (>= 2)
+
+
+@dataclass
 class TrainConfig:
     save_every: int = 1                # snapshot cadence (reference main.py argv)
     snapshot_dir: str = "snapshots"
@@ -722,6 +767,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    agg: AggConfig = field(default_factory=AggConfig)
 
     # ------------------------------------------------------------------ io
     def to_dict(self) -> dict[str, Any]:

@@ -184,7 +184,20 @@ class ServerOptimizer:
         return {"m": zeros, "v": self._tmap(lambda p: onp.zeros_like(p), params), "t": 0}
 
     def step(self, global_params: Any, mean_params: Any) -> Any:
-        """One server update on host arrays: returns the new global params."""
+        """One server update on host arrays: returns the new global params.
+
+        ``mean_params`` is the round's aggregation PROPOSAL — whatever
+        the active reducer produced: the flat weighted mean, a
+        hierarchical per-tier robust reduce (``agg.mode=hierarchical``),
+        or a staleness-weighted buffered commit (``agg.mode=async``,
+        :func:`fedrec_tpu.agg.commit.fold_commit` applied to
+        ``global_params``). The FedOpt contract is aggregation-agnostic
+        by construction: the pseudo-gradient is always
+        ``global - proposal`` against the SAME ``global_params`` the
+        proposal was built from, so server momentum/adaptivity state
+        sees identical update semantics in every agg mode — a
+        zero-staleness all-reporting async commit yields bit-the-same
+        pseudo-gradient as the flat mean."""
         import numpy as onp
 
         delta = self._tmap(lambda g, m: g - m, global_params, mean_params)

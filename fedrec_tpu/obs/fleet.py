@@ -1009,6 +1009,51 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
             perf[wid] = pw
     if perf:
         report["perf"] = perf
+
+    # ---- aggregation (fedrec_tpu.agg): the async commit authority's
+    # quorum/staleness accounting and each worker's marginal commit gate.
+    # gate_ms BEFORE going async is the barrier critical path ("Critical
+    # path" above: the slowest worker gates everyone); AFTER it is
+    # agg.worker_gate_ms — a straggler that never closes a quorum stays
+    # ~0 there. Silent when no worker published agg.* metrics.
+    agg: dict[str, Any] = {}
+    for wid in sorted(workers):
+        snap = workers[wid].last_snapshot()
+        if snap is None:
+            continue
+        if not any(
+            k.startswith("agg.") for k in (snap.get("metrics") or {})
+        ):
+            continue
+        aw: dict[str, Any] = {}
+        for key, name in (
+            ("commits", "agg.commits_total"),
+            ("late_folds", "agg.late_folds_total"),
+            ("stale_drops", "agg.stale_drops_total"),
+            ("staleness", "agg.staleness"),
+            ("quorum_wait_ms", "agg.quorum_wait_ms"),
+            ("gate_saved_ms", "agg.gate_saved_ms"),
+            ("tier_reduce_ms", "agg.tier_reduce_ms"),
+            ("buffer_pending", "agg.buffer_pending"),
+            ("pushes", "agg.pushes_total"),
+            ("global_version", "agg.global_version"),
+        ):
+            v = _snap_value(snap, name)
+            if v is not None:
+                aw[key] = v
+        gate = {
+            row["labels"].get("worker", "?"): row["value"]
+            for row in _metric_values(snap, "agg.worker_gate_ms")
+            if "value" in row
+        }
+        if gate:
+            # only the commit authority holds the per-worker gate cells
+            aw["worker_gate_ms"] = gate
+            aw["role"] = "agg_server"
+        if aw:
+            agg[wid] = aw
+    if agg:
+        report["agg"] = agg
     return report
 
 
@@ -1129,6 +1174,51 @@ def render_fleet_text(report: dict) -> str:
             if "verdict" in pw:
                 parts.append(f"verdict={pw['verdict']}")
             lines.append(f"worker {wid}: " + ", ".join(parts))
+        lines.append("")
+    agg = report.get("agg")
+    if agg:
+        lines.append("## Aggregation")
+        for wid, aw in agg.items():
+            parts = []
+            if aw.get("role") == "agg_server":
+                parts.append("commit authority")
+            for key, fmt in (
+                ("commits", "commits={:d}"),
+                ("global_version", "version={:d}"),
+                ("pushes", "pushes={:d}"),
+                ("late_folds", "late_folds={:d}"),
+                ("stale_drops", "stale_drops={:d}"),
+                ("buffer_pending", "pending={:d}"),
+            ):
+                if key in aw:
+                    parts.append(fmt.format(int(aw[key])))
+            for key, fmt in (
+                ("staleness", "staleness={:.2f}"),
+                ("quorum_wait_ms", "quorum_wait={:.0f}ms"),
+                ("gate_saved_ms", "gate_saved={:.0f}ms"),
+                ("tier_reduce_ms", "tier_reduce={:.1f}ms"),
+            ):
+                if key in aw:
+                    parts.append(fmt.format(aw[key]))
+            lines.append(f"worker {wid}: " + ", ".join(parts))
+        # the before/after gate panel: barrier gate_ms (critical path,
+        # above) vs each worker's async marginal gate — the async win is
+        # the straggler's row reading ~0 here
+        gates = {
+            w: g
+            for aw in agg.values()
+            for w, g in (aw.get("worker_gate_ms") or {}).items()
+        }
+        if gates:
+            crit = report.get("critical_path") or {}
+            lines.append("")
+            lines.append("gate_ms before (sync barrier) -> after (async commit):")
+            for w in sorted(gates):
+                before = crit.get(w, {}).get("gate_ms")
+                before_s = "-" if before is None else f"{before:.1f}"
+                lines.append(
+                    f"  worker {w}: {before_s} -> {gates[w]:.1f} ms"
+                )
         lines.append("")
     if not report.get("workers"):
         lines.append("(no workers found)")

@@ -315,6 +315,7 @@ def aggregate_from_hosts(
     codec_state: Any = None,
     topk_ratio: float = 0.01,
     error_feedback: bool = True,
+    agg: Any = None,
 ) -> Any:
     """Participation-weighted FedAvg across processes.
 
@@ -357,10 +358,57 @@ def aggregate_from_hosts(
     DP ordering: clipping + noise happened per step inside training, so
     the delta this function encodes is already privatized — encode runs
     strictly AFTER the mechanism, ε-accounting untouched.
+
+    ``agg`` (an ``agg`` config section): ``mode="hierarchical"`` reduces
+    the gathered (P, ...) stacks up an ``agg.tree_fanout`` tree instead
+    of one flat robust sweep — the robust method applies PER TIER, the
+    tree reforms from the CURRENT gathered world every round (membership
+    shrink/rejoin needs no topology invalidation), and the per-level-max
+    timing lands in the ``agg.tier_reduce_ms`` gauge.  With
+    ``method="mean"`` the hierarchical mode deliberately takes the flat
+    einsum below: a tree of partial sums IS the flat weighted mean
+    algebraically, so lowering it keeps bit-identity (docs/DESIGN.md).
+    Codec composition is decode-before-reduce as always: the tiers see
+    densified contributions, so every decodable codec composes with the
+    hierarchical reduce exactly as with the flat one.
     """
     validate_compress(compress)
     w_arr = np.asarray(weight, np.float32)
     method = getattr(robust, "method", "mean") if robust is not None else "mean"
+    hier = getattr(agg, "mode", "flat") == "hierarchical" and method != "mean"
+
+    def _robust_reduce(stacks, w_np, fallback):
+        """The one robust-reduction seam: flat sweep, or the tiered tree
+        when agg.mode='hierarchical' (mean never lands here — it lowers
+        to the flat einsum/sum paths, bit-identical by algebra)."""
+        from fedrec_tpu.fed.robust import robust_reduce_tree_np
+
+        if not hier:
+            return robust_reduce_tree_np(
+                stacks, w_np, method,
+                trim_k=robust.trim_k, clip_norm=robust.clip_norm,
+                fallback_tree=fallback,
+            )
+        from fedrec_tpu.agg.hierarchy import (
+            tree_critical_path_ms,
+            tree_reduce_np,
+        )
+
+        stats: dict = {}
+        reduced = tree_reduce_np(
+            stacks, w_np, int(getattr(agg, "tree_fanout", 2)), method,
+            trim_k=robust.trim_k, clip_norm=robust.clip_norm,
+            fallback_tree=fallback, stats=stats,
+        )
+        from fedrec_tpu.obs import get_registry
+
+        get_registry().gauge(
+            "agg.tier_reduce_ms",
+            "per-level-max tier-reduce time of the last hierarchical "
+            "round, summed over levels (the tree's parallel critical path)",
+        ).set(tree_critical_path_ms(stats))
+        return reduced
+
     if method != "mean":
         from fedrec_tpu.fed.robust import validate_robust_method
 
@@ -385,7 +433,6 @@ def aggregate_from_hosts(
             encode_tree,
             tree_dense_nbytes,
         )
-        from fedrec_tpu.fed.robust import robust_reduce_tree_np
 
         raw = jax.tree_util.tree_map(
             lambda p: np.asarray(p, np.float32), params
@@ -425,13 +472,9 @@ def aggregate_from_hosts(
         stacks = decode_gathered(gathered, enc)  # leaves: (P, *shape) dense
         w_np = np.asarray(weights)
         if method != "mean":
-            reduced = robust_reduce_tree_np(
-                stacks, w_np, method,
-                trim_k=robust.trim_k, clip_norm=robust.clip_norm,
-                # m==0 coordinates keep this host's own decoded
-                # contribution (the in-graph fallback contract)
-                fallback_tree=own_decoded,
-            )
+            # m==0 coordinates keep this host's own decoded
+            # contribution (the in-graph fallback contract)
+            reduced = _robust_reduce(stacks, w_np, own_decoded)
         else:
             coeff = (np.where(w_np > 0, w_np, 0.0) / total).astype(np.float32)
 
@@ -455,8 +498,6 @@ def aggregate_from_hosts(
         )
 
     if method != "mean":
-        from fedrec_tpu.fed.robust import robust_reduce_tree_np
-
         raw = jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32), params)
         gathered, weights = _allgather_stacked((raw, w_arr))
         from fedrec_tpu.comms import tree_dense_nbytes
@@ -464,11 +505,8 @@ def aggregate_from_hosts(
         _bank_dcn_bytes(up=tree_dense_nbytes(raw))
         if float(np.sum(weights)) == 0.0:
             return params  # nobody reported; keep local (no NaNs)
-        reduced = robust_reduce_tree_np(
-            gathered, np.asarray(weights), method,
-            trim_k=robust.trim_k, clip_norm=robust.clip_norm,
-            fallback_tree=raw,  # m==0 coordinates keep local (in-graph parity)
-        )
+        # m==0 coordinates keep local (in-graph parity)
+        reduced = _robust_reduce(gathered, np.asarray(weights), raw)
         return jax.tree_util.tree_map(
             lambda m, p: jnp.asarray(np.asarray(m, np.asarray(p).dtype)),
             reduced, params,
@@ -523,6 +561,7 @@ class CoordinatorRuntime:
         error_feedback: bool = True,
         membership: Any = None,
         epoch: int = 0,
+        agg: Any = None,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
@@ -544,6 +583,7 @@ class CoordinatorRuntime:
         self.degraded_by_timeout = False
         self.compress = validate_compress(compress)
         self.robust = robust  # fed.robust section; None/mean = plain FedAvg
+        self.agg = agg  # agg section; hierarchical = per-tier robust reduce
         self.topk_ratio = topk_ratio
         self.error_feedback = error_feedback
         # this process's error-feedback residual for the biased codecs
@@ -687,7 +727,7 @@ class CoordinatorRuntime:
                 params, w, compress=self.compress, base=base,
                 robust=self.robust, codec_state=self.codec_state,
                 topk_ratio=self.topk_ratio,
-                error_feedback=self.error_feedback,
+                error_feedback=self.error_feedback, agg=self.agg,
             ),
             lambda: params,
             timeout_s=deadline if deadline else None,

@@ -165,6 +165,68 @@ class Trainer:
                 "the full-size residency shard.fsdp exists to avoid — use "
                 "int8/sign1bit or shard.fsdp=1"
             )
+        # ---- aggregation topology (agg.*, fedrec_tpu.agg): validated up
+        # front like robust/codec — a mode that would silently never apply
+        # is a misconfiguration, not a preference
+        if cfg.agg.mode not in ("flat", "hierarchical", "async"):
+            raise ValueError(
+                f"unknown agg.mode {cfg.agg.mode!r}; expected 'flat', "
+                "'hierarchical', or 'async'"
+            )
+        if cfg.agg.tree_fanout < 2:
+            raise ValueError(
+                f"agg.tree_fanout={cfg.agg.tree_fanout} must be >= 2"
+            )
+        if cfg.agg.staleness_cap < 0:
+            raise ValueError(
+                f"agg.staleness_cap={cfg.agg.staleness_cap} must be >= 0"
+            )
+        if cfg.agg.quorum < 0 or cfg.agg.quorum > cfg.fed.num_clients:
+            raise ValueError(
+                f"agg.quorum={cfg.agg.quorum} must be in "
+                f"[0, fed.num_clients={cfg.fed.num_clients}] "
+                "(0 = all-reporting)"
+            )
+        if cfg.agg.mode != "flat" and not self.strategy.sync_params_every_round:
+            raise ValueError(
+                f"agg.mode={cfg.agg.mode!r} requires a strategy that syncs "
+                "params every round (param_avg or coordinator); "
+                f"fed.strategy={cfg.fed.strategy!r} never aggregates, so "
+                "the aggregation topology would silently never apply"
+            )
+        if cfg.agg.mode == "async":
+            if cfg.train.rounds_per_scan > 1:
+                raise ValueError(
+                    "agg.mode='async' is incompatible with "
+                    "train.rounds_per_scan > 1: the buffered quorum commit "
+                    "is a host-side round-boundary operation and cannot run "
+                    "inside a compiled round chain"
+                )
+            if cfg.fed.dcn_compress != "none":
+                raise ValueError(
+                    "agg.mode='async' does not yet compose with "
+                    "fed.dcn_compress: the buffered commit folds dense "
+                    "host-side deltas (compress the hierarchical mode's "
+                    "tiers instead, or keep agg.mode='flat')"
+                )
+        # the host-side tiered reduce only engages for NON-linear robust
+        # methods: a tree of (sum(w*x), sum(w)) partials with one final
+        # divide IS the flat weighted mean algebraically, so
+        # hierarchical+mean lowers to the unchanged in-graph collective
+        # and stays bit-identical by construction (tests/test_agg.py)
+        self._agg_async = cfg.agg.mode == "async"
+        self._agg_hier_host = (
+            cfg.agg.mode == "hierarchical" and rb.method != "mean"
+        )
+        self._agg_version = 0
+        self.agg_buffer = None
+        if self._agg_async:
+            from fedrec_tpu.agg import AggBuffer, CommitPolicy
+
+            self.agg_buffer = AggBuffer()
+            self._agg_policy = CommitPolicy(
+                quorum=cfg.agg.quorum, staleness_cap=cfg.agg.staleness_cap
+            )
         self.chaos = None
         if cfg.chaos.enabled:
             from fedrec_tpu.fed.chaos import FaultPlan
@@ -602,6 +664,52 @@ class Trainer:
                             "cohort schedule will differ from an "
                             "uninterrupted run"
                         )
+                if self._agg_async:
+                    # pending late contributions survive the restart; a
+                    # missing/foreign/mismatched sidecar starts empty
+                    # (late updates are droppable by design — the commit
+                    # version still resumes so staleness stays coherent)
+                    from fedrec_tpu.agg.buffer import (
+                        AGG_BUFFER_SIDECAR,
+                        AggBuffer,
+                    )
+
+                    agg_sidecar = self.snapshots.directory / AGG_BUFFER_SIDECAR
+                    if agg_sidecar.exists():
+                        try:
+                            buf, tag, ver = AggBuffer.load_state(
+                                agg_sidecar.read_bytes()
+                            )
+                        except ValueError as e:
+                            print(
+                                "[trainer] ignoring unreadable agg-buffer "
+                                f"sidecar: {e}"
+                            )
+                        else:
+                            self._agg_version = ver
+                            if tag == self.start_round - 1:
+                                self.agg_buffer = buf
+                                if len(buf):
+                                    print(
+                                        f"[trainer] restored {len(buf)} "
+                                        "pending async contribution(s) at "
+                                        f"commit version {ver}"
+                                    )
+                            else:
+                                print(
+                                    "[trainer] agg-buffer sidecar from round "
+                                    f"{tag} != snapshot round "
+                                    f"{self.start_round - 1}; starting with "
+                                    "an empty buffer (pending late updates "
+                                    "dropped)"
+                                )
+                    else:
+                        print(
+                            "[trainer] resuming an agg.mode=async run "
+                            f"without {AGG_BUFFER_SIDECAR} — pending late "
+                            "contributions (if any) are lost and the commit "
+                            "version restarts"
+                        )
             try:
                 # resolved config rides with the snapshots so serving can
                 # rebuild the exact model without the operator re-typing
@@ -815,6 +923,44 @@ class Trainer:
             "faults injected by the chaos FaultPlan, labeled by kind "
             "(drop/straggle/nan/scale/flip); rollback replays re-count",
             labels=("kind",),
+        )
+        # ---- aggregation-topology instruments (fedrec_tpu.agg; the fleet
+        # report's Aggregation section): always registered, zero-valued
+        # under agg.mode='flat' so the section simply doesn't render
+        self._m_agg_commits = self.registry.counter(
+            "agg.commits_total",
+            "async quorum commits performed (global version bumps)",
+        )
+        self._m_agg_late = self.registry.counter(
+            "agg.late_folds_total",
+            "buffered contributions folded with staleness > 0",
+        )
+        self._m_agg_stale = self.registry.counter(
+            "agg.stale_drops_total",
+            "buffered contributions dropped past agg.staleness_cap",
+        )
+        self._g_agg_staleness = self.registry.gauge(
+            "agg.staleness",
+            "mean staleness (commits behind) of the last commit's folds",
+        )
+        self._g_agg_quorum_wait = self.registry.gauge(
+            "agg.quorum_wait_ms",
+            "first-report -> quorum-close time of the last async commit "
+            "(what the commit waited, vs the barrier's slowest reporter)",
+        )
+        self._g_agg_gate_saved = self.registry.gauge(
+            "agg.gate_saved_ms",
+            "slowest-report latency minus the quorum-close latency of the "
+            "last async commit — the barrier wait the quorum removed",
+        )
+        self._g_agg_pending = self.registry.gauge(
+            "agg.buffer_pending",
+            "contributions in the async buffer awaiting a later commit",
+        )
+        self._g_agg_tier_ms = self.registry.gauge(
+            "agg.tier_reduce_ms",
+            "per-level-max tier-reduce time of the last hierarchical "
+            "round, summed over levels (the tree's parallel critical path)",
         )
         # ---- cohort-engine instruments (fedrec-obs report's Participation
         # section): zero-valued when fed.population is off
@@ -2182,7 +2328,11 @@ class Trainer:
             )
 
         round_start_global = None
-        if self.server_opt is not None:
+        if (
+            self.server_opt is not None
+            or self._agg_async
+            or self._agg_hier_host
+        ):
             # all clients hold identical params at round entry (initial
             # replication / previous sync); client 0 IS the global model.
             # Materialized to host: the server step is a round-boundary op,
@@ -2285,7 +2435,34 @@ class Trainer:
                     jax.tree_util.tree_map(lambda x: x[0], tables)
                 )
 
-        if self.strategy.sync_params_every_round:
+        if self.strategy.sync_params_every_round and (
+            self._agg_async or self._agg_hier_host
+        ):
+            # host-side aggregation topologies (agg.mode): the in-graph
+            # param_sync never runs — per-client params come to host and
+            # the commit/tree reduce replaces the flat collective.
+            # (hierarchical + method="mean" is NOT this path: it lowers to
+            # the unchanged flat collective below, bit-identical.)
+            with tracer.span(
+                "aggregate", round=round_idx, method=cfg.fed.robust.method,
+                mode=cfg.agg.mode, **self._uplink_span_args(weights_np),
+            ):
+                # drain the round's step backlog via a data dependency
+                # before the cross-device host gather (same XLA:CPU
+                # rendezvous-deadline rationale as the FedOpt branch)
+                if losses:
+                    jax.block_until_ready(losses[-1])
+                if self._agg_async:
+                    self._agg_async_commit(
+                        round_idx, weights_np, round_start_global
+                    )
+                else:
+                    self._agg_hier_sync(
+                        round_idx, weights_np, round_start_global
+                    )
+            self._m_robust_rounds.inc(method=cfg.fed.robust.method)
+            self._count_uplink(weights_np)
+        elif self.strategy.sync_params_every_round:
             with tracer.span(
                 "aggregate", round=round_idx, method=cfg.fed.robust.method,
                 **self._uplink_span_args(weights_np),
@@ -2347,6 +2524,144 @@ class Trainer:
         result = RoundResult(round_idx, train_loss)
         self._eval_if_due(result)
         return result
+
+    # ------------------------------------------- aggregation topologies
+    def _agg_param_stacks(self) -> tuple[Any, Any]:
+        """Every client's (user, news) params to host as (C, ...) leaf
+        stacks — the raw material of the host-side topologies (the state
+        keeps its leading clients axis, so one fetch covers the cohort)."""
+        return jax.tree_util.tree_map(
+            np.asarray, (self.state.user_params, self.state.news_params)
+        )
+
+    def _agg_hier_sync(
+        self, round_idx: int, weights_np: np.ndarray, round_start_global: Any
+    ) -> None:
+        """Hierarchical robust sync (agg.mode='hierarchical' with a
+        non-mean fed.robust method): the cohort's contributions reduce up
+        an agg.tree_fanout tree, the robust method applied PER TIER — the
+        trajectory this produces genuinely diverges from the flat robust
+        reduce (documented in docs/DESIGN.md; bounded-delta pinned).  The
+        topology is rebuilt from the live cohort every round, so a
+        membership shrink/rejoin reforms the tree by construction."""
+        from fedrec_tpu.agg.hierarchy import (
+            tree_critical_path_ms,
+            tree_reduce_np,
+        )
+
+        cfg = self.cfg
+        if float(np.sum(weights_np)) == 0.0:
+            return  # nobody reported: every client keeps its local params
+        stacks = self._agg_param_stacks()
+        stats: dict = {}
+        reduced = tree_reduce_np(
+            stacks,
+            weights_np,
+            cfg.agg.tree_fanout,
+            cfg.fed.robust.method,
+            trim_k=cfg.fed.robust.trim_k,
+            clip_norm=cfg.fed.robust.clip_norm,
+            fallback_tree=round_start_global,
+            stats=stats,
+        )
+        self._g_agg_tier_ms.set(tree_critical_path_ms(stats))
+        new_u, new_n = reduced
+        if self.server_opt is not None:
+            # FedOpt sees the tree's output exactly where it saw the flat
+            # mean: a proposal the server optimizer steps toward
+            new_u, new_n = self.server_opt.step(
+                round_start_global, (new_u, new_n)
+            )
+        self.set_global_params(
+            jax.tree_util.tree_map(jnp.asarray, new_u),
+            jax.tree_util.tree_map(jnp.asarray, new_n),
+        )
+
+    def _agg_async_commit(
+        self, round_idx: int, weights_np: np.ndarray, round_start_global: Any
+    ) -> None:
+        """In-process buffered quorum commit (agg.mode='async' on a cohort
+        deployment): per-slot report latencies come from the SAME seeded
+        chaos distribution the population engine uses, the agg.quorum
+        earliest reporters commit NOW, and the stragglers' deltas land in
+        the buffer to fold staleness-weighted into the next commit — the
+        cohort-simulation twin of the agg/server.py wire deployment."""
+        from fedrec_tpu.agg.buffer import BufferEntry
+        from fedrec_tpu.agg.commit import fold_commit
+        from fedrec_tpu.fed.chaos import population_report
+
+        cfg = self.cfg
+        part = np.flatnonzero(weights_np > 0)
+        if part.size == 0:
+            return  # nobody reported: no commit, clients keep local params
+        client_ids = np.asarray(self._slot_occupants)
+        _, latency = population_report(self.chaos, round_idx, client_ids)
+        latency = np.asarray(latency, np.float64)
+
+        base_leaves, treedef = jax.tree_util.tree_flatten(round_start_global)
+        stack_leaves = jax.tree_util.tree_flatten(self._agg_param_stacks())[0]
+
+        k = self._agg_policy.quorum_for(int(part.size))
+        order = part[np.argsort(latency[part], kind="stable")]
+        on_time, late = order[:k], order[k:]
+        quorum_lat = float(latency[order[k - 1]])
+        max_lat = float(latency[order[-1]])
+
+        def entry(slot: int) -> BufferEntry:
+            return BufferEntry(
+                worker=str(int(client_ids[slot])),
+                round=round_idx,
+                epoch=self.agg_buffer.epoch,
+                based_on=self._agg_version,
+                weight=float(weights_np[slot]),
+                arrival_ms=float(latency[slot]),
+                leaves=[
+                    s[slot] - b for s, b in zip(stack_leaves, base_leaves)
+                ],
+            )
+
+        # prior rounds' stragglers fold into THIS commit (staleness >= 1)
+        commit_entries = self.agg_buffer.take_all()
+        commit_entries += [entry(int(s)) for s in on_time]
+        # the stragglers' entries MUST capture the pre-commit version:
+        # their deltas are against round_start_global, so based_on has to
+        # be the version that global carried — building them after the
+        # bump would under-count their staleness by one commit (full
+        # instead of 1/(1+s) weight, cap off by one)
+        late_entries = [entry(int(s)) for s in late]
+        new_leaves, stats = fold_commit(
+            base_leaves,
+            commit_entries,
+            self._agg_version,
+            self._agg_policy,
+            method=cfg.fed.robust.method,
+            trim_k=cfg.fed.robust.trim_k,
+            clip_norm=cfg.fed.robust.clip_norm,
+        )
+        self._agg_version = stats.version
+        for e in late_entries:
+            self.agg_buffer.add(e)
+
+        self._m_agg_commits.inc()
+        self._m_agg_late.inc(float(stats.late_folds))
+        self._m_agg_stale.inc(float(stats.stale_drops))
+        self._g_agg_staleness.set(stats.mean_staleness)
+        self._g_agg_quorum_wait.set(quorum_lat - float(latency[order[0]]))
+        self._g_agg_gate_saved.set(max_lat - quorum_lat)
+        self._g_agg_pending.set(float(len(self.agg_buffer)))
+
+        new_u, new_n = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        if self.server_opt is not None:
+            # identical update semantics: the commit output is a proposal,
+            # exactly like the flat weighted mean (a zero-staleness
+            # all-reporting commit IS that mean)
+            new_u, new_n = self.server_opt.step(
+                round_start_global, (new_u, new_n)
+            )
+        self.set_global_params(
+            jax.tree_util.tree_map(jnp.asarray, new_u),
+            jax.tree_util.tree_map(jnp.asarray, new_n),
+        )
 
     @staticmethod
     def _round_loss_mean(mean_cells: np.ndarray, loss_cells: np.ndarray) -> float:
@@ -3028,7 +3343,8 @@ class Trainer:
                 # resumes round-r cohort schedule against round r-k params
                 self.snapshots.save(
                     round_idx, self.state,
-                    wait=self.server_opt is not None or self._pop_engine,
+                    wait=self.server_opt is not None or self._pop_engine
+                    or self._agg_async,
                 )
                 if self.server_opt is not None:
                     from fedrec_tpu.train.checkpoint import atomic_write_bytes
@@ -3036,6 +3352,21 @@ class Trainer:
                     atomic_write_bytes(
                         self.snapshots.directory / "server_opt_state.msgpack",
                         self.server_opt.state_bytes(round_idx),
+                    )
+                if self._agg_async:
+                    # buffered late contributions pair with THIS snapshot:
+                    # same blocking discipline as the FedOpt sidecar (the
+                    # sidecar must never be newer than the snapshot, or a
+                    # crash between the two would fold round-r late deltas
+                    # against round r-k params on resume)
+                    from fedrec_tpu.agg.buffer import AGG_BUFFER_SIDECAR
+                    from fedrec_tpu.train.checkpoint import atomic_write_bytes
+
+                    atomic_write_bytes(
+                        self.snapshots.directory / AGG_BUFFER_SIDECAR,
+                        self.agg_buffer.state_bytes(
+                            round_idx, self._agg_version
+                        ),
                     )
                 if self._pop_engine:
                     from fedrec_tpu.train.checkpoint import (

@@ -1,0 +1,204 @@
+#!/bin/bash
+# Buffered-async aggregation smoke (agg.mode=async across processes),
+# CPU-only:
+#
+#   An agg.server commit authority (quorum 3 of world 4) + 4 async
+#   workers, each a single-process Trainer pushing round deltas over the
+#   fleet wire — worker 3 chaos-delayed 4s per push. Must prove:
+#
+#     1. QUORUM COMMIT: the global advances one version per round on the
+#        3 on-time workers alone — the straggler is still sleeping when
+#        the commit fires (>= ROUNDS commits total);
+#     2. LATE FOLD: the straggler's delayed contribution lands in the
+#        buffer and folds staleness-weighted into a LATER commit
+#        (late_folds >= 1), never dropped while within agg.staleness_cap;
+#     3. GATE -> ~0: the straggler's marginal commit gate (the async
+#        analogue of the barrier's critical-path gate_ms) stays ~0 — a
+#        barrier deployment would have charged it the full 4s straggle
+#        every round;
+#     4. FLEET: `fedrec-obs fleet` merges the commit authority's obs
+#        artifacts with the workers' and renders the Aggregation panel
+#        (commits / late folds / per-worker gate before-vs-after);
+#     5. PERSIST: the pending buffer survives on disk (agg_buffer.npz in
+#        --state-dir) after the service stops.
+#
+#   scripts/async_smoke.sh     # or: make async-smoke
+#
+# Artifacts land under /tmp/fedrec_async_smoke for inspection.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${ASYNC_SMOKE_DIR:-/tmp/fedrec_async_smoke}
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+APORT=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
+
+ROUNDS=3
+STRAGGLE_MS=4000
+
+# --------------------------------------------------- the commit authority
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.agg.server "127.0.0.1:$APORT" \
+    --quorum 3 --world 4 \
+    --obs-dir "$OUT/obs/worker_aggserver" \
+    --state-dir "$OUT/aggstate" \
+    > "$OUT/aggserver.log" 2>&1 &
+AGG_PID=$!
+cleanup() { kill "$AGG_PID" 2>/dev/null || true; }
+trap cleanup EXIT
+sleep 1
+
+# ------------------------------------------------------- 4 async workers
+run_worker() {
+    local extra=()
+    if [ "$1" = 3 ]; then
+        # the scripted straggler: sleeps at the push boundary, so every
+        # commit it could have gated fires without it
+        extra=(--set chaos.enabled=true --set "chaos.straggle_ms=$STRAGGLE_MS")
+    fi
+    env -u PALLAS_AXON_POOL_IPS -u XLA_FLAGS JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m fedrec_tpu.cli.run "$ROUNDS" 8 10 \
+        --agg-server "127.0.0.1:$APORT" --worker-id "$1" \
+        --strategy param_avg --clients 1 \
+        --synthetic --synthetic-train 256 --synthetic-news 64 \
+        --set model.bert_hidden=48 --set data.max_his_len=10 \
+        --set data.max_title_len=12 --set model.news_dim=32 \
+        --set model.num_heads=4 --set model.head_dim=8 \
+        --set model.query_dim=16 \
+        --set "train.snapshot_dir=$OUT/d$1" \
+        --set "train.eval_every=$ROUNDS" \
+        --set optim.user_lr=0.001 --set optim.news_lr=0.001 \
+        --set "obs.dir=$OUT/obs" \
+        "${extra[@]}" \
+        > "$OUT/worker_$1.log" 2>&1
+}
+
+PIDS=()
+for wid in 0 1 2 3; do
+    run_worker "$wid" & PIDS+=($!)
+done
+FAIL=0
+for i in 0 1 2 3; do
+    wait "${PIDS[$i]}" || { echo "[async-smoke] worker $i FAILED"; FAIL=1; }
+done
+if [ "$FAIL" -ne 0 ]; then
+    echo "[async-smoke] logs:"
+    tail -n 40 "$OUT"/worker_*.log "$OUT/aggserver.log"
+    exit 1
+fi
+
+# ------------------------------------------- [1-3] commit-log assertions
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" APORT="$APORT" ROUNDS="$ROUNDS" STRAGGLE_MS="$STRAGGLE_MS" \
+    python - <<'PY'
+import json
+import os
+
+from fedrec_tpu.obs.fleet import request_json_line
+
+out = os.environ["OUT"]
+rounds = int(os.environ["ROUNDS"])
+straggle_ms = float(os.environ["STRAGGLE_MS"])
+st = request_json_line(
+    "127.0.0.1", int(os.environ["APORT"]), {"cmd": "status"}, timeout_s=10.0
+)
+print("[async-smoke] aggserver status:", json.dumps(st))
+
+# 1. quorum commit: one version per round from the on-time trio (the
+# straggler's pushes can only ADD commits, never block one)
+assert st["version"] >= rounds, st
+assert {"0", "1", "2", "3"} <= set(st["workers"]), st
+commits = st["commits"]
+assert len(commits) == st["version"], commits
+# every commit fired at exactly quorum (3 distinct pending) or more
+assert all(c["quorum"] >= 3 for c in commits), commits
+
+# 2. late fold: the straggler's delayed delta folded with staleness > 0
+late = sum(c["late_folds"] for c in commits)
+assert late >= 1, f"no late folds in {commits}"
+assert sum(c["stale_drops"] for c in commits) == 0, \
+    "a within-cap contribution was dropped"
+
+# 3. gate -> ~0: worker 3 is charged (almost) nothing. The barrier
+# would charge it ~straggle_ms EVERY round; async charges it only when
+# it happens to close a quorum, a race window of one push (< half the
+# straggle even then).
+w3_gates = [c["gate_ms"] for c in commits if c["closer"] == "3"]
+w3_total = sum(w3_gates)
+assert w3_total < straggle_ms / 2, (
+    f"straggler charged {w3_total:.0f} ms across {len(w3_gates)} commit(s)"
+)
+barrier_cost = straggle_ms * rounds
+print(f"[async-smoke] straggler gate: {w3_total:.0f} ms async vs "
+      f"~{barrier_cost:.0f} ms the barrier would have charged")
+PY
+
+# straggler really straggled (the chaos knob engaged)
+grep -q "straggling" "$OUT/worker_3.log" \
+    || { echo "[async-smoke] worker 3 never straggled"; exit 1; }
+
+# ------------------------------------------------ stop the service (flushes
+# its obs artifacts + the buffer sidecar on the way down)
+kill -TERM "$AGG_PID"
+wait "$AGG_PID" 2>/dev/null || true
+
+# ---------------------------------------------------- [5] buffer persisted
+test -s "$OUT/aggstate/agg_buffer.npz" \
+    || { echo "[async-smoke] no persisted buffer sidecar"; exit 1; }
+
+# ------------------------------------------------------- [4] the fleet leg
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.cli.obs fleet "$OUT/obs" > "$OUT/fleet_report.txt"
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.cli.obs fleet "$OUT/obs" --json \
+    > "$OUT/fleet_report.json"
+
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" ROUNDS="$ROUNDS" STRAGGLE_MS="$STRAGGLE_MS" \
+    python - <<'PY'
+import json
+import os
+from pathlib import Path
+
+out = Path(os.environ["OUT"])
+rounds = int(os.environ["ROUNDS"])
+straggle_ms = float(os.environ["STRAGGLE_MS"])
+
+rep = json.loads((out / "fleet_report.json").read_text())
+workers = set(rep["workers"])
+assert {"0", "1", "2", "3", "aggserver"} <= workers, workers
+
+agg = rep.get("agg") or {}
+assert "aggserver" in agg, f"no agg section for the commit authority: {agg}"
+srv = agg["aggserver"]
+assert srv.get("role") == "agg_server", srv
+assert srv.get("commits", 0) >= rounds, srv
+assert srv.get("late_folds", 0) >= 1, srv
+gates = srv.get("worker_gate_ms") or {}
+assert "3" in gates, gates
+assert gates["3"] < straggle_ms / 2, (
+    f"fleet report charges the straggler {gates['3']:.0f} ms"
+)
+# the workers' own push accounting made it into the merge
+pushed = [w for w, aw in agg.items() if aw.get("pushes", 0) >= rounds]
+assert len(pushed) >= 4, f"workers with >= {rounds} pushes: {pushed}"
+
+text = (out / "fleet_report.txt").read_text()
+assert "## Aggregation" in text, "no Aggregation panel in the fleet text"
+assert "gate_ms before" in text, "no before/after gate panel"
+print("[async-smoke] fleet leg OK "
+      f"(straggler gate {gates['3']:.0f} ms in the merged report)")
+PY
+
+echo "[async-smoke] OK"
