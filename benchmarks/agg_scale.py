@@ -22,6 +22,12 @@ The round-barrier cost model at pod scale has three regimes:
                 the max. The banked ``gate_saved_ms`` lane is the
                 straggler tail the quorum cut off.
 
+The async lanes also bank an **uplink-bytes column**: the wire cost of
+the K on-time contributions dense vs countsketch-encoded, priced from
+REAL ``fedrec_tpu.comms.encode_leaf`` payload buffers (payload size is
+shape-deterministic, so one encode per leaf prices every contribution).
+The structural check requires async+sketch < async-dense at 10k+.
+
 Latency draws ride the production population engine
 (``fed.chaos.population_report``: seeded lognormal, median
 ``chaos.pop_straggle_ms``) so the tail shape matches what the trainer's
@@ -72,6 +78,8 @@ QUORUM_FRAC = 0.8                    # async commit quorum fraction
 LEAF_DIMS = ((48,), (16,))           # synthetic per-client contribution
 STRAGGLE_MS = 200.0                  # lognormal median report latency
 STRAGGLE_SIGMA = 0.7
+SKETCH_WIDTH = 0.1                   # fed.dcn_sketch_width for the uplink lane
+SKETCH_CODEC = "countsketch"
 SUBLINEAR_FROM = 10_000              # the acceptance bound applies at 10k+
 REL_FLOOR = 1.0                      # timing lanes may regress 2x (they are
                                      # µs..ms host reduces on a shared rig)
@@ -179,6 +187,21 @@ def measure_cohort(cohort: int, repeats: int) -> dict:
         max(1, repeats - 1),
     )
 
+    # ---- async uplink bytes: the K on-time contributions over the wire,
+    # dense f32 vs sketch-encoded — priced from REAL encode_leaf payload
+    # buffers (payload size is shape-deterministic: one encode per leaf
+    # prices every contribution of that shape)
+    from fedrec_tpu.comms import encode_leaf, payload_nbytes
+
+    sample = [s[0] for s in stacks]
+    dense_per = sum(4 * x.size for x in sample)
+    sketch_per = sum(
+        payload_nbytes(encode_leaf(
+            x, SKETCH_CODEC, sketch_width=SKETCH_WIDTH, leaf_id=j,
+        ))
+        for j, x in enumerate(sample)
+    )
+
     return {
         "cohort": cohort,
         "hosts": len(hosts),
@@ -198,6 +221,12 @@ def measure_cohort(cohort: int, repeats: int) -> dict:
         "flat_round_ms": round(max_lat + flat_ms, 3),
         "hier_round_ms": round(max_lat + host_ms + tree_ms, 3),
         "async_round_ms": round(quorum_lat + fold_ms, 3),
+        # uplink-bytes column: the K on-time pushes, dense vs sketch
+        # (deterministic — real encoded payload sizes x quorum)
+        "async_uplink_dense_mb": round(k * dense_per / (1024 * 1024), 4),
+        "async_uplink_sketch_mb": round(k * sketch_per / (1024 * 1024), 4),
+        "uplink_bytes_per_push_dense": int(dense_per),
+        "uplink_bytes_per_push_sketch": int(sketch_per),
     }
 
 
@@ -222,10 +251,20 @@ def structural_check(rows: list[dict]) -> list[str]:
                 f"{r['flat_round_ms']} at {r['cohort']} clients — the "
                 "quorum cut saved nothing"
             )
+        if (r["cohort"] >= SUBLINEAR_FROM
+                and r["async_uplink_sketch_mb"] >= r["async_uplink_dense_mb"]):
+            problems.append(
+                f"async_uplink_sketch_mb {r['async_uplink_sketch_mb']} >= "
+                f"async_uplink_dense_mb {r['async_uplink_dense_mb']} at "
+                f"{r['cohort']} clients — the sketch uplink saved nothing"
+            )
     return problems
 
 
-_EXACT = ("max_latency_ms", "quorum_latency_ms", "gate_saved_ms")
+_EXACT = (
+    "max_latency_ms", "quorum_latency_ms", "gate_saved_ms",
+    "async_uplink_dense_mb", "async_uplink_sketch_mb",
+)
 _TIMING = (
     "flat_reduce_ms", "hier_host_ms", "hier_tree_ms", "async_fold_ms",
 )
@@ -243,6 +282,13 @@ def check(baseline: dict, rows: list[dict]) -> int:
             )
             continue
         for lane in _EXACT:
+            if base.get(lane) is None:
+                regressions.append(
+                    f"cohort {row['cohort']} {lane}: missing from the "
+                    "baseline — scenario drifted; re-bank deliberately "
+                    "(--bank)"
+                )
+                continue
             if abs(row[lane] - base[lane]) > 1e-6 * max(abs(base[lane]), 1.0):
                 regressions.append(
                     f"cohort {row['cohort']} {lane}: {base[lane]} -> "
@@ -361,6 +407,8 @@ def main() -> int:
                 "leaf_dims": [list(d) for d in LEAF_DIMS],
                 "straggle_ms": STRAGGLE_MS,
                 "straggle_sigma": STRAGGLE_SIGMA,
+                "sketch_width": SKETCH_WIDTH,
+                "sketch_codec": SKETCH_CODEC,
                 "method": "trimmed_mean (flat/hier), mean fold (async)",
                 "repeats": repeats,
             },

@@ -55,13 +55,19 @@ REFERENCE_ROUND_MB = 2 * REFERENCE_UP_MB
 
 MB = 1024 * 1024
 
-# codec contract on the measured client->server buffers (ISSUE 7
+# codec contract on the measured client->server buffers (ISSUE 7/17
 # acceptance): the benchmark fails rather than bank a violating artifact.
 # int8's exact measured ratio is 4n/(n+4t) for t tensors of n total
 # elements — asymptotically 4x, a hair under on real trees because each
 # tensor ships one f32 scale; the threshold tolerates exactly that
-# overhead (0.5% on the flagship trees) and nothing else.
-MIN_REDUCTION = {"int8": 3.98, "sign1bit": 20.0, "topk": 20.0}
+# overhead (0.5% on the flagship trees) and nothing else. The linear
+# sketches ship ~width x dense f32 (one f32 bucket array per leaf), so
+# the default width 0.1 prices ~10x; the contract floor is 8x to absorb
+# the small-leaf rounding (m = max(1, round(width * n)) per leaf).
+MIN_REDUCTION = {
+    "int8": 3.98, "sign1bit": 20.0, "topk": 20.0,
+    "countsketch": 8.0, "randproj": 8.0,
+}
 
 
 def tree_bytes(tree) -> int:
@@ -72,7 +78,7 @@ def tree_bytes(tree) -> int:
     )
 
 
-def codec_rows(trainable_tree, topk_ratio: float) -> dict:
+def codec_rows(trainable_tree, topk_ratio: float, sketch_width: float) -> dict:
     """Encode the REAL flagship trainable trees through every registered
     codec; report measured wire-buffer bytes and the up-direction
     reduction vs dense f32. Raises if the codec contract is violated."""
@@ -84,7 +90,9 @@ def codec_rows(trainable_tree, topk_ratio: float) -> dict:
         if codec == "none":
             up = dense
         else:
-            up = encode_tree(trainable_tree, codec, topk_ratio).nbytes()
+            up = encode_tree(
+                trainable_tree, codec, topk_ratio, sketch_width=sketch_width
+            ).nbytes()
         reduction = dense / up
         rows[codec] = {
             "up_mb_per_client": round(up / MB, 4),
@@ -103,7 +111,8 @@ def codec_rows(trainable_tree, topk_ratio: float) -> dict:
 
 
 def run_codec_tradeoff(
-    codecs, rounds: int, target_auc: float, topk_ratio: float
+    codecs, rounds: int, target_auc: float, topk_ratio: float,
+    sketch_width: float,
 ) -> dict:
     """One short CPU training run per codec on the topic-structured
     synthetic corpus: measured uplink bytes per client-round (from the
@@ -139,6 +148,7 @@ def run_codec_tradeoff(
         cfg.fed.strategy = "param_avg"
         cfg.fed.dcn_compress = codec
         cfg.fed.dcn_topk_ratio = topk_ratio
+        cfg.fed.dcn_sketch_width = sketch_width
         cfg.optim.user_lr = cfg.optim.news_lr = 5e-3
         cfg.train.seed = 0
         cfg.train.snapshot_dir = ""
@@ -223,6 +233,8 @@ def main() -> int:
     ap.add_argument("--target-auc", type=float, default=0.55,
                     help="time-to-AUC threshold on the synthetic corpus")
     ap.add_argument("--topk-ratio", type=float, default=0.01)
+    ap.add_argument("--sketch-width", type=float, default=0.1,
+                    help="linear-sketch size ratio (fed.dcn_sketch_width)")
     args = ap.parse_args()
 
     # self-harden: this is a host-side measurement — it must not touch (or
@@ -252,7 +264,7 @@ def main() -> int:
     host_trees = jax.tree_util.tree_map(
         np.asarray, (state.user_params, state.news_params)
     )
-    codecs = codec_rows(host_trees, args.topk_ratio)
+    codecs = codec_rows(host_trees, args.topk_ratio, args.sketch_width)
 
     # steps per round at the reference's federated deployment scale:
     # MIND-small ~ 230k train impressions over 9 clients, batch 64
@@ -278,6 +290,7 @@ def main() -> int:
         # (fed.dcn_compress; fan-out full precision in every mode)
         "codecs": codecs,
         "codec_topk_ratio": args.topk_ratio,
+        "codec_sketch_width": args.sketch_width,
         "grad_avg_steps_per_round": steps,
         # both-direction / both-direction — like for like
         "reduction_vs_reference": {
@@ -305,7 +318,8 @@ def main() -> int:
         from fedrec_tpu.comms import CODECS
 
         out["codec_tradeoff"] = run_codec_tradeoff(
-            CODECS, args.rounds, args.target_auc, args.topk_ratio
+            CODECS, args.rounds, args.target_auc, args.topk_ratio,
+            args.sketch_width,
         )
         out["codec_tradeoff_note"] = (
             "one short CPU run per codec on the topic-structured synthetic "

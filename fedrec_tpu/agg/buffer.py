@@ -14,6 +14,21 @@ with their own treedef and unflatten the committed result.  That keeps
 the wire format (npz of positional leaves) and the checkpoint sidecar
 model-agnostic.
 
+Entries carry a ``codec`` tag naming the REPRESENTATION their leaves
+are in — ``"none"`` for dense deltas (per-contribution codecs like
+int8/sign1bit/topk are decoded at push time, so their entries land
+here dense), or a linear sketch codec (``countsketch``/``randproj``)
+whose per-leaf sketch arrays fold IN SKETCH SPACE at commit and decode
+exactly once (see :func:`fedrec_tpu.agg.commit.fold_commit`).
+
+The buffer also banks per-edge error-feedback residuals
+(``ef_residuals``, worker id -> the dense residual the edge's last
+encode left behind, tagged with the global version it was based on).
+They ride the same npz sidecar as the pending entries, so a restart or
+a membership-epoch reform preserves exactly the residuals whose
+workers survived — a dead worker's residual is dropped with its
+pending entry.
+
 The buffer checkpoints beside the model snapshot
 (``agg_buffer.npz`` via :meth:`AggBuffer.state_bytes` /
 :meth:`AggBuffer.load_state`, the same round-tagged sidecar discipline
@@ -50,6 +65,11 @@ class BufferEntry:
     weight: float
     arrival_ms: float               # simulated/measured arrival latency
     leaves: list = field(default_factory=list)  # ordered np.ndarray leaf list
+    # the representation `leaves` is in: "none" = dense delta leaves;
+    # a linear sketch codec name = per-leaf sketch arrays that fold in
+    # sketch space (per-contribution codecs decode at push, so they
+    # never appear here — their entries are already dense)
+    codec: str = "none"
 
 
 class AggBuffer:
@@ -58,6 +78,12 @@ class AggBuffer:
     def __init__(self, epoch: int = 0):
         self.epoch = int(epoch)
         self.entries: list[BufferEntry] = []
+        # worker id -> {"based_on": int, "leaves": [np.ndarray, ...]}:
+        # the dense encode residual the edge banked at its last push
+        # (error feedback for per-contribution codecs), tagged with the
+        # global version the encoded contribution was based on so a
+        # restore knows which commit the correction belongs to
+        self.ef_residuals: dict[str, dict] = {}
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -76,6 +102,22 @@ class AggBuffer:
     def pending_workers(self) -> set[str]:
         return {e.worker for e in self.entries}
 
+    def bank_residual(
+        self, worker: str, based_on: int, leaves: list
+    ) -> None:
+        """Bank the edge's encode residual against the version its
+        contribution was based on — a re-push replaces it (same
+        replace-don't-double rule as :meth:`add`)."""
+        self.ef_residuals[str(worker)] = {
+            "based_on": int(based_on),
+            "leaves": [np.asarray(x) for x in leaves],
+        }
+
+    def residual_for(self, worker: str) -> list | None:
+        """The dense residual banked for ``worker``, or ``None``."""
+        banked = self.ef_residuals.get(str(worker))
+        return None if banked is None else banked["leaves"]
+
     def take_all(self) -> list[BufferEntry]:
         out, self.entries = self.entries, []
         return out
@@ -85,8 +127,10 @@ class AggBuffer:
         entries from workers that did not survive it (their deltas were
         produced by a peer that no longer exists — folding them would
         resurrect a dead member's weight).  Entries from survivors stay
-        buffered and fold with staleness weighting.  Returns the number
-        dropped."""
+        buffered and fold with staleness weighting; so do their banked
+        error-feedback residuals (a dead worker's residual goes with
+        its entry — there is no future push to correct).  Returns the
+        number of ENTRIES dropped."""
         if epoch < self.epoch:
             raise ValueError(
                 f"membership epoch moved backwards: {self.epoch} -> {epoch}"
@@ -96,11 +140,17 @@ class AggBuffer:
             return 0
         before = len(self.entries)
         self.entries = [e for e in self.entries if e.worker not in drop_dead]
+        for w in drop_dead:
+            self.ef_residuals.pop(str(w), None)
         return before - len(self.entries)
 
     # ------------------------------------------------------- persistence
     def state_bytes(self, round_idx: int, version: int) -> bytes:
-        """Round-tagged npz sidecar (one blob, atomically writable)."""
+        """Round-tagged npz sidecar (one blob, atomically writable).
+        The ``codec`` tag and the ``residuals`` section are additive —
+        a pre-codec (v1) blob simply has neither and loads as all-dense
+        with no banked residuals."""
+        residual_workers = sorted(self.ef_residuals)
         meta = {
             "magic": _MAGIC,
             "round": int(round_idx),
@@ -115,8 +165,17 @@ class AggBuffer:
                     "weight": float(e.weight),
                     "arrival_ms": float(e.arrival_ms),
                     "num_leaves": len(e.leaves),
+                    "codec": e.codec,
                 }
                 for e in self.entries
+            ],
+            "residuals": [
+                {
+                    "worker": w,
+                    "based_on": int(self.ef_residuals[w]["based_on"]),
+                    "num_leaves": len(self.ef_residuals[w]["leaves"]),
+                }
+                for w in residual_workers
             ],
         }
         arrays = {
@@ -124,6 +183,13 @@ class AggBuffer:
             for i, e in enumerate(self.entries)
             for j, leaf in enumerate(e.leaves)
         }
+        arrays.update(
+            {
+                f"r{k}_leaf{j}": np.asarray(leaf)
+                for k, w in enumerate(residual_workers)
+                for j, leaf in enumerate(self.ef_residuals[w]["leaves"])
+            }
+        )
         buf = io.BytesIO()
         np.savez(
             buf, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8),
@@ -161,6 +227,15 @@ class AggBuffer:
                         weight=float(ent["weight"]),
                         arrival_ms=float(ent["arrival_ms"]),
                         leaves=leaves,
+                        codec=str(ent.get("codec", "none")),
                     )
                 )
+            for k, res in enumerate(meta.get("residuals", [])):
+                buf.ef_residuals[str(res["worker"])] = {
+                    "based_on": int(res["based_on"]),
+                    "leaves": [
+                        np.asarray(z[f"r{k}_leaf{j}"])
+                        for j in range(res["num_leaves"])
+                    ],
+                }
         return buf, int(meta["round"]), int(meta["version"])

@@ -18,6 +18,20 @@ FedBuff/FedAsync standard: a late delta was computed against an older
 base, so folding it against the NEW base is an approximation whose
 error grows with staleness — the decay bounds it, and entries past
 `agg.staleness_cap` are dropped outright (``stale_drops``).
+
+Codec composition (``fed.dcn_compress`` x ``agg.mode='async'``): an
+entry tagged with a LINEAR sketch codec carries per-leaf sketch arrays
+and folds IN SKETCH SPACE — the staleness-weighted sum runs over the
+sketches and each leaf decodes exactly ONCE per commit, which by
+linearity equals decoding every contribution first (the
+decode-after-sum identity, pinned in ``tests/test_agg.py``).
+Per-contribution codecs (int8/sign1bit/topk) never reach the fold
+encoded: :func:`encode_contribution` decodes them AT PUSH TIME with
+per-edge error-feedback residuals, so their entries arrive dense
+(``codec="none"``) and staleness reordering moves only weights, never
+the reconstruction.  Robust non-mean methods need per-contribution
+deltas to rank, so a sketch entry under a robust fold is a hard
+ValueError, mirroring the synchronous coordinator's guard.
 """
 
 from __future__ import annotations
@@ -29,9 +43,23 @@ import jax
 import numpy as np
 
 from fedrec_tpu.agg.buffer import BufferEntry
+from fedrec_tpu.comms import (
+    SKETCH_PAYLOAD_KEY,
+    codec_caps,
+    decode_leaf,
+    encode_leaf,
+    payload_nbytes,
+    validate_codec,
+)
 from fedrec_tpu.fed.robust import robust_reduce_tree_np
 
-__all__ = ["CommitPolicy", "CommitStats", "fold_commit", "staleness_weight"]
+__all__ = [
+    "CommitPolicy",
+    "CommitStats",
+    "encode_contribution",
+    "fold_commit",
+    "staleness_weight",
+]
 
 
 @dataclass
@@ -67,6 +95,78 @@ def staleness_weight(staleness: int) -> float:
     return 1.0 / (1.0 + max(0, int(staleness)))
 
 
+def encode_contribution(
+    delta_leaves: list[np.ndarray],
+    codec: str,
+    *,
+    topk_ratio: float = 0.01,
+    sketch_width: float = 0.1,
+    sketch_seed: int = 0,
+    residual_leaves: list[np.ndarray] | None = None,
+) -> tuple[list[np.ndarray], str, list[np.ndarray] | None, int]:
+    """Run one edge's dense delta through ``fed.dcn_compress`` for the
+    async buffer.  Returns ``(entry_leaves, entry_codec,
+    new_residual_leaves, encoded_nbytes)``:
+
+    - ``codec="none"``: the delta passes through dense;
+      ``encoded_nbytes`` is the real f32 wire cost.
+    - per-contribution codecs (int8/sign1bit/topk): encode then decode
+      IMMEDIATELY (decode-at-push) — the entry buffers dense
+      (``entry_codec="none"``) so staleness-reordered folds are pure
+      weight arithmetic.  Codecs with error-feedback support add the
+      banked ``residual_leaves`` BEFORE encoding and return the new
+      residual (what the encode dropped) for the caller to bank
+      against the version this contribution was based on.
+    - linear sketches (countsketch/randproj): the entry leaves ARE the
+      per-leaf sketch arrays (``entry_codec=codec``); the fold sums
+      them in sketch space and :func:`fold_commit` decodes once per
+      commit.  No residual — the sketch is unbiased, there is no
+      systematic dropped mass to feed back.
+
+    ``encoded_nbytes`` is measured from the payloads actually built
+    (``payload_nbytes``), not dtype arithmetic — it is the uplink
+    number the agg-scale benchmark banks.
+    """
+    delta_leaves = [np.asarray(x, np.float32) for x in delta_leaves]
+    if codec == "none":
+        return (
+            delta_leaves,
+            "none",
+            None,
+            int(sum(x.nbytes for x in delta_leaves)),
+        )
+    validate_codec(codec)
+    caps = codec_caps(codec)
+    if not caps.decodes_per_contribution:
+        key = SKETCH_PAYLOAD_KEY[codec]
+        payloads = [
+            encode_leaf(
+                x, codec, sketch_width=sketch_width,
+                sketch_seed=sketch_seed, leaf_id=j,
+            )
+            for j, x in enumerate(delta_leaves)
+        ]
+        nbytes = int(sum(payload_nbytes(p) for p in payloads))
+        return [p[key] for p in payloads], codec, None, nbytes
+
+    use_ef = caps.supports_error_feedback and residual_leaves is not None
+    acc = (
+        [d + np.asarray(r, np.float32)
+         for d, r in zip(delta_leaves, residual_leaves)]
+        if use_ef
+        else delta_leaves
+    )
+    decoded, new_residual, nbytes = [], [], 0
+    for j, a in enumerate(acc):
+        payload = encode_leaf(a, codec, topk_ratio, leaf_id=j)
+        nbytes += payload_nbytes(payload)
+        d = decode_leaf(payload, codec, a.shape, leaf_id=j)
+        decoded.append(d)
+        new_residual.append(a - d)
+    residual_out = new_residual if caps.supports_error_feedback else None
+    return decoded, "none", residual_out, int(nbytes)
+
+
 def fold_commit(
     base_leaves: list[np.ndarray],
     entries: list[BufferEntry],
@@ -75,6 +175,7 @@ def fold_commit(
     method: str = "mean",
     trim_k: int = 1,
     clip_norm: float = 10.0,
+    sketch_seed: int = 0,
 ) -> tuple[list[np.ndarray], CommitStats]:
     """Fold ``entries`` into ``base_leaves`` (the version-``version``
     global, as an ordered leaf list) and return the version-``version+1``
@@ -82,7 +183,14 @@ def fold_commit(
     are dropped, never folded; an all-dropped commit returns the base
     unchanged at the bumped version (the global advances so the
     droppers' staleness keeps growing — matching a quorum of on-time
-    entries arriving with nothing foldable)."""
+    entries arriving with nothing foldable).
+
+    Entries tagged with a linear sketch codec fold in sketch space:
+    their staleness-weighted sum runs over the per-leaf sketch arrays
+    and each leaf decodes ONCE (``sketch_seed`` must match the
+    encoders' — the shared hash geometry).  Dense entries and sketch
+    entries share one weight normalizer, so a mixed buffer is still a
+    single weighted mean."""
     t0 = time.monotonic()
     stats = CommitStats(version=version + 1)
     fold: list[BufferEntry] = []
@@ -103,6 +211,18 @@ def fold_commit(
         stats.fold_ms = (time.monotonic() - t0) * 1e3
         return [np.asarray(x) for x in base_leaves], stats
 
+    sketch_codecs = sorted({e.codec for e in fold if e.codec != "none"})
+    if method != "mean" and sketch_codecs:
+        raise ValueError(
+            f"fed.robust.method={method!r} cannot fold sketch-coded "
+            f"entries (codecs {sketch_codecs} in the buffer): order "
+            "statistics rank per-contribution deltas, but a sketch "
+            "entry's contribution only exists after the summed decode. "
+            "Push per-contribution codecs (int8/sign1bit/topk) or "
+            "fed.dcn_compress='none' to async workers under a robust "
+            "fold, or set fed.robust.method='mean'."
+        )
+
     w = np.asarray(
         [e.weight * staleness_weight(s) for e, s in zip(fold, stales)],
         np.float64,
@@ -112,30 +232,78 @@ def fold_commit(
     stats.mean_staleness = float(np.mean(stales))
     stats.max_staleness = int(max(stales))
 
-    stacks = [
-        np.stack([np.asarray(e.leaves[j], np.float64) for e in fold], axis=0)
-        for j in range(len(base_leaves))
-    ]
-    total = float(np.sum(w * (w > 0)))
+    wmask = w > 0
+    total = float(np.sum(w * wmask))
     if method == "mean" or total == 0.0:
         if total == 0.0:
-            delta = [np.zeros_like(np.asarray(b, np.float64)) for b in base_leaves]
-        else:
-            wmask = w > 0
             delta = [
-                np.einsum(
-                    "p,p...->...",
-                    w * wmask,
-                    np.where(
-                        wmask.reshape((-1,) + (1,) * (s.ndim - 1)), s, 0.0
-                    ),
-                )
-                / total
-                for s in stacks
+                np.zeros_like(np.asarray(b, np.float64)) for b in base_leaves
             ]
+        else:
+            num = [
+                np.zeros(np.asarray(b).shape, np.float64)
+                for b in base_leaves
+            ]
+            dense_ix = [i for i, e in enumerate(fold) if e.codec == "none"]
+            if dense_ix:
+                wd = (w * wmask)[dense_ix]
+                md = wmask[dense_ix]
+                for j in range(len(base_leaves)):
+                    stack = np.stack(
+                        [
+                            np.asarray(fold[i].leaves[j], np.float64)
+                            for i in dense_ix
+                        ],
+                        axis=0,
+                    )
+                    num[j] += np.einsum(
+                        "p,p...->...",
+                        wd,
+                        np.where(
+                            md.reshape((-1,) + (1,) * (stack.ndim - 1)),
+                            stack,
+                            0.0,
+                        ),
+                    )
+            for codec in sketch_codecs:
+                ix = [i for i, e in enumerate(fold) if e.codec == codec]
+                ws = (w * wmask)[ix]
+                key = SKETCH_PAYLOAD_KEY[codec]
+                for j, b in enumerate(base_leaves):
+                    # the staleness-weighted reduce runs over SKETCHES;
+                    # one decode per (codec, leaf) per commit
+                    sk = np.einsum(
+                        "p,p...->...",
+                        ws,
+                        np.stack(
+                            [
+                                np.asarray(fold[i].leaves[j], np.float64)
+                                for i in ix
+                            ],
+                            axis=0,
+                        ),
+                    )
+                    num[j] += np.asarray(
+                        decode_leaf(
+                            {key: sk.astype(np.float32)},
+                            codec,
+                            tuple(np.asarray(b).shape),
+                            sketch_seed=sketch_seed,
+                            leaf_id=j,
+                        ),
+                        np.float64,
+                    )
+            delta = [n / total for n in num]
     else:
         # robust methods reduce the delta stacks directly; fallback 0
-        # (an all-non-finite coordinate leaves the global untouched)
+        # (an all-non-finite coordinate leaves the global untouched) —
+        # the sketch guard above guarantees every entry here is dense
+        stacks = [
+            np.stack(
+                [np.asarray(e.leaves[j], np.float64) for e in fold], axis=0
+            )
+            for j in range(len(base_leaves))
+        ]
         reduced = robust_reduce_tree_np(
             stacks,
             w,

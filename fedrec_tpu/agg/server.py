@@ -11,7 +11,7 @@ membership service and telemetry collector use):
     hello  {worker, epoch}                 -> {version, quorum, have_global}
     init   {worker, payload}               -> {version}   (first caller seeds v0)
     push   {worker, round, epoch, based_on,
-            weight, payload}               -> {version, committed}
+            weight, payload[, codec]}      -> {version, committed}
     global {since}                         -> {version[, payload]}
     status {}                              -> commit/gate/buffer accounting
 
@@ -20,6 +20,18 @@ model-agnostic contract).  A push lands in the :class:`AggBuffer`; once
 ``agg.quorum`` distinct workers are pending the commit fires through
 :func:`~fedrec_tpu.agg.commit.fold_commit` — stragglers' later pushes
 fold staleness-weighted into the NEXT commit.
+
+A push may declare a ``codec`` (``fed.dcn_compress`` on the worker):
+its payload is then a base64 npz of per-leaf ENCODED payload dicts
+(``p{i}__{key}`` arrays) instead of dense leaves.  Per-contribution
+codecs (int8/sign1bit/topk) are decoded AT PUSH TIME against the
+global's leaf shapes — the worker holds its own error-feedback
+residual, the server only densifies — while linear sketches
+(countsketch/randproj) buffer as raw sketch arrays and fold in sketch
+space at commit, decoding once (``--sketch-seed`` must match the
+workers' ``fed.dcn_sketch_seed``).  ``agg.push_bytes_total`` counts
+the wire bytes actually received per worker — the uplink number the
+async-compression claim rests on.
 
 Gate accounting (the before/after panel's "after" side): per commit the
 quorum-CLOSING arrival is charged ``t_K - t_{K-1}`` — the marginal
@@ -45,8 +57,21 @@ import numpy as np
 
 from fedrec_tpu.agg.buffer import AggBuffer, BufferEntry
 from fedrec_tpu.agg.commit import CommitPolicy, fold_commit
+from fedrec_tpu.comms import (
+    SKETCH_PAYLOAD_KEY,
+    codec_caps,
+    decode_leaf,
+    validate_codec,
+)
 
-__all__ = ["AggServer", "decode_leaves", "encode_leaves", "main"]
+__all__ = [
+    "AggServer",
+    "decode_leaves",
+    "decode_payloads",
+    "encode_leaves",
+    "encode_payloads",
+    "main",
+]
 
 
 def encode_leaves(leaves: list[np.ndarray]) -> str:
@@ -58,6 +83,33 @@ def encode_leaves(leaves: list[np.ndarray]) -> str:
 def decode_leaves(payload: str) -> list[np.ndarray]:
     with np.load(io.BytesIO(base64.b64decode(payload))) as z:
         return [np.asarray(z[f"leaf{i}"]) for i in range(len(z.files))]
+
+
+def encode_payloads(payloads: list[dict]) -> str:
+    """Encoded-contribution wire blob: each leaf's codec payload dict is
+    flattened to ``p{i}__{key}`` arrays in one npz — the compressed twin
+    of :func:`encode_leaves` (same transport, different contents)."""
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        **{
+            f"p{i}__{k}": np.asarray(v)
+            for i, p in enumerate(payloads)
+            for k, v in p.items()
+        },
+    )
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def decode_payloads(payload: str) -> list[dict]:
+    """Inverse of :func:`encode_payloads` — rebuilds the ordered per-leaf
+    payload-dict list."""
+    out: dict[int, dict] = {}
+    with np.load(io.BytesIO(base64.b64decode(payload))) as z:
+        for name in z.files:
+            head, key = name.split("__", 1)
+            out.setdefault(int(head[1:]), {})[key] = np.asarray(z[name])
+    return [out[i] for i in range(len(out))]
 
 
 class AggServer:
@@ -74,6 +126,7 @@ class AggServer:
         world: int = 0,
         obs_dir: str | None = None,
         state_dir: str | None = None,
+        sketch_seed: int = 0,
     ):
         self.host = host
         self.port = port
@@ -82,6 +135,10 @@ class AggServer:
         self.trim_k = trim_k
         self.clip_norm = clip_norm
         self.world = int(world)
+        # the shared sketch hash geometry (fed.dcn_sketch_seed): every
+        # pushing worker must encode with the SAME seed or the summed
+        # sketch decodes garbage
+        self.sketch_seed = int(sketch_seed)
         self.obs_dir = obs_dir
         self.state_dir = state_dir
         self.version = 0
@@ -90,6 +147,8 @@ class AggServer:
         self.commit_log: list[dict] = []
         self._arrival: dict[str, float] = {}   # pending worker -> arrival time
         self._gate_ms: dict[str, float] = {}   # worker -> LAST commit gate
+        self._push_bytes: dict[str, float] = {}  # worker -> wire bytes total
+        self._push_counts: dict[str, int] = {}   # worker -> pushes total
         self._workers: set[str] = set()
         self._lock = threading.Lock()
         self._srv: socket.socket | None = None
@@ -134,6 +193,13 @@ class AggServer:
             "marginal commit delay charged to this worker at its last "
             "commit (the async analogue of critical-path gate_ms; a "
             "straggler that never closes a quorum stays ~0)",
+            labels=("worker",),
+        )
+        self._m_push_bytes = reg.counter(
+            "agg.push_bytes_total",
+            "contribution wire bytes received per worker (base64 npz as "
+            "shipped) — compare codec'd vs dense pushes for the async "
+            "uplink saving",
             labels=("worker",),
         )
 
@@ -265,9 +331,26 @@ class AggServer:
 
     def _push(self, req: dict) -> dict:
         worker = str(req["worker"])
+        codec = str(req.get("codec", "none"))
         with self._lock:
             if self.global_leaves is None:
                 return {"error": "push before init: no v0 global"}
+            self._m_push_bytes.inc(
+                float(len(req["payload"])), worker=worker
+            )
+            self._push_bytes[worker] = (
+                self._push_bytes.get(worker, 0.0) + float(len(req["payload"]))
+            )
+            self._push_counts[worker] = self._push_counts.get(worker, 0) + 1
+            if codec == "none":
+                leaves, entry_codec = decode_leaves(req["payload"]), "none"
+            else:
+                try:
+                    leaves, entry_codec = self._decode_push(
+                        codec, req["payload"]
+                    )
+                except ValueError as e:
+                    return {"error": f"bad push codec: {e}"}
             entry = BufferEntry(
                 worker=worker,
                 round=int(req["round"]),
@@ -275,7 +358,8 @@ class AggServer:
                 based_on=int(req["based_on"]),
                 weight=float(req.get("weight", 1.0)),
                 arrival_ms=time.monotonic() * 1e3,
-                leaves=decode_leaves(req["payload"]),
+                leaves=leaves,
+                codec=entry_codec,
             )
             self.buffer.add(entry)
             self._workers.add(worker)
@@ -284,6 +368,41 @@ class AggServer:
             self._g_pending.set(float(len(self.buffer)))
             self._persist()
             return {"version": self.version, "committed": committed}
+
+    def _decode_push(self, codec: str, payload: str) -> tuple[list, str]:
+        """Caller holds the lock.  An encoded push becomes buffer leaves:
+        per-contribution codecs densify NOW (decode-at-push — the
+        worker-side residual already corrected what the encode drops),
+        linear sketches buffer raw and fold in sketch space at commit."""
+        validate_codec(codec)
+        payloads = decode_payloads(payload)
+        assert self.global_leaves is not None
+        if len(payloads) != len(self.global_leaves):
+            raise ValueError(
+                f"push has {len(payloads)} encoded leaves, global has "
+                f"{len(self.global_leaves)}"
+            )
+        if codec_caps(codec).decodes_per_contribution:
+            leaves = [
+                decode_leaf(
+                    p, codec, tuple(np.asarray(g).shape),
+                    sketch_seed=self.sketch_seed, leaf_id=j,
+                )
+                for j, (p, g) in enumerate(
+                    zip(payloads, self.global_leaves)
+                )
+            ]
+            return leaves, "none"
+        if self.method != "mean":
+            # reject at the wire, not inside the commit: a sketch entry
+            # under a robust fold would ValueError at quorum time and
+            # poison every pending worker's commit
+            raise ValueError(
+                f"sketch codec {codec!r} cannot fold under robust method "
+                f"{self.method!r}; push int8/sign1bit/topk/none instead"
+            )
+        key = SKETCH_PAYLOAD_KEY[codec]
+        return [np.asarray(p[key]) for p in payloads], codec
 
     def _maybe_commit(self) -> bool:
         """Caller holds the lock.  Fires when quorum-many DISTINCT
@@ -298,7 +417,7 @@ class AggServer:
         self.global_leaves, stats = fold_commit(
             self.global_leaves, entries, self.version, self.policy,
             method=self.method, trim_k=self.trim_k,
-            clip_norm=self.clip_norm,
+            clip_norm=self.clip_norm, sketch_seed=self.sketch_seed,
         )
         self.version = stats.version
         # gate attribution: the quorum-closing arrival is charged its
@@ -357,6 +476,8 @@ class AggServer:
                 "epoch": self.buffer.epoch,
                 "commits": list(self.commit_log),
                 "gate_ms": dict(self._gate_ms),
+                "push_bytes": dict(self._push_bytes),
+                "push_counts": dict(self._push_counts),
             }
 
 
@@ -386,6 +507,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--state-dir", default=None,
                         help="persist the pending buffer here across "
                              "restarts (agg_buffer.npz)")
+    parser.add_argument("--sketch-seed", type=int, default=0,
+                        help="shared sketch hash seed (fed.dcn_sketch_seed) "
+                             "for decoding sketch-coded pushes — must match "
+                             "every worker's")
     args = parser.parse_args(argv)
     host, port = args.address.rsplit(":", 1)
     if args.obs_dir:
@@ -398,6 +523,7 @@ def main(argv: list[str] | None = None) -> int:
                             staleness_cap=args.staleness_cap),
         method=args.method, world=args.world,
         obs_dir=args.obs_dir, state_dir=args.state_dir,
+        sketch_seed=args.sketch_seed,
     ).start()
     print(f"[aggserver] serving on {server.address}", flush=True)
 

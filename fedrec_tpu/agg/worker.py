@@ -10,7 +10,14 @@ round:
   3. pushes the delta to the :mod:`~fedrec_tpu.agg.server` commit
      authority (after the scripted chaos delay, when this worker is the
      smoke's straggler — ``chaos.straggle_ms`` is the host-driven
-     straggle knob and sleeps here, at the push boundary),
+     straggle knob and sleeps here, at the push boundary).  With
+     ``fed.dcn_compress`` set, the push ships ENCODED per-leaf payloads
+     instead of dense leaves: linear sketches go up raw (the server
+     folds them in sketch space), per-contribution codecs go up with
+     this worker's locally-held error-feedback residual already folded
+     in — the residual lives at the encoding edge, banked against the
+     version the push was based on, and what the encode drops this
+     round rides the next round's delta,
   4. polls for a NEWER committed global (bounded wait — on timeout the
      worker proceeds from its own params and its next push simply
      carries higher staleness; that is the async contract, not an
@@ -48,12 +55,34 @@ def run_async_worker(
     """Drive ``trainer`` for its configured rounds against the commit
     authority at ``server`` ("HOST:PORT").  Returns the round history
     (same shape as ``Trainer.run``)."""
-    from fedrec_tpu.agg.server import decode_leaves, encode_leaves
+    from fedrec_tpu.agg.server import (
+        decode_leaves,
+        encode_leaves,
+        encode_payloads,
+    )
+    from fedrec_tpu.comms import (
+        codec_caps,
+        decode_leaf,
+        encode_leaf,
+        payload_nbytes,
+        validate_codec,
+    )
     from fedrec_tpu.obs.fleet import request_json_line
 
     cfg = trainer.cfg
     host, port_s = server.rsplit(":", 1)
     port = int(port_s)
+    codec = cfg.fed.dcn_compress
+    if codec != "none":
+        # "auto" never reaches here (the trainer guard pins async to
+        # concrete codecs); a bad name fails before any training
+        validate_codec(codec)
+    use_ef = (
+        codec != "none"
+        and codec_caps(codec).supports_error_feedback
+        and cfg.fed.dcn_error_feedback
+    )
+    ef_residual: list | None = None   # this edge's banked encode error
 
     def rpc(req: dict) -> dict:
         return request_json_line(host, port, req, timeout_s=timeout_s)
@@ -69,6 +98,11 @@ def run_async_worker(
     )
     c_pushes = trainer.registry.counter(
         "agg.pushes_total", "contribution deltas this worker pushed"
+    )
+    c_uplink = trainer.registry.counter(
+        "agg.uplink_bytes_total",
+        "encoded contribution bytes this worker pushed (measured payload "
+        "buffers, pre-base64) — the async uplink the codec compresses",
     )
 
     epoch = 0
@@ -104,6 +138,33 @@ def run_async_worker(
 
         after, _ = _flatten_params(trainer)
         delta = [a - b for a, b in zip(after, base)]
+        if codec == "none":
+            wire_payload = encode_leaves(delta)
+            c_uplink.inc(float(sum(np.asarray(d).nbytes for d in delta)))
+        else:
+            # the error-feedback residual lives HERE, at the encoding
+            # edge: fold last round's dropped mass into this round's
+            # delta before encoding, bank what this encode drops
+            acc = (
+                [d + r for d, r in zip(delta, ef_residual)]
+                if use_ef and ef_residual is not None
+                else delta
+            )
+            payloads = [
+                encode_leaf(
+                    a, codec, cfg.fed.dcn_topk_ratio,
+                    sketch_width=cfg.fed.dcn_sketch_width,
+                    sketch_seed=cfg.fed.dcn_sketch_seed, leaf_id=j,
+                )
+                for j, a in enumerate(acc)
+            ]
+            if use_ef:
+                ef_residual = [
+                    a - decode_leaf(p, codec, a.shape, leaf_id=j)
+                    for j, (a, p) in enumerate(zip(acc, payloads))
+                ]
+            wire_payload = encode_payloads(payloads)
+            c_uplink.inc(float(sum(payload_nbytes(p) for p in payloads)))
         if straggle_s > 0:
             print(
                 f"[agg-worker {worker_id}] straggling "
@@ -114,7 +175,7 @@ def run_async_worker(
         resp = rpc({
             "cmd": "push", "worker": worker_id, "round": round_idx,
             "epoch": epoch, "based_on": version, "weight": 1.0,
-            "payload": encode_leaves(delta),
+            "payload": wire_payload, "codec": codec,
         })
         c_pushes.inc()
         g_staleness.set(float(max(0, int(resp["version"]) - version)))

@@ -382,23 +382,35 @@ def main(argv: list[str] | None = None) -> int:
             "lease service"
         )
 
+    if cfg.fed.dcn_compress == "auto":
+        # the adaptive per-leaf map is pinned from the Trainer's in-graph
+        # warmup telemetry; the coordinator wire path has no warmup window
+        # yet, so a concrete codec must be named per deployment
+        raise ValueError(
+            "fed.dcn_compress='auto' needs the trainer's warmup telemetry "
+            "and is not available on the coordinator path; pin a concrete "
+            "codec (int8/sign1bit/topk/countsketch/randproj) per deployment"
+        )
     if cfg.fed.robust.method != "mean" and cfg.fed.dcn_compress != "none":
-        # robust x compress is LEGAL for every registered codec: the gather
-        # decodes each contribution per process BEFORE any reduction
+        # robust x compress is LEGAL for every per-contribution codec: the
+        # gather decodes each contribution per process BEFORE any reduction
         # (decode-before-reduce, fedrec_tpu.comms), so trimmed-mean/median
-        # judge clients, not quantization noise. The fail-fast survives only
-        # for a codec whose contributions cannot be decoded individually —
-        # checked HERE (same policy as validate_compress): raised lazily
-        # inside the aggregation collective, it would be misread by the
-        # watchdog as a peer failure and silently degrade every host to
-        # standalone training.
-        from fedrec_tpu.comms import codec_decodes_per_contribution
+        # judge clients, not quantization noise. The fail-fast survives for
+        # the LINEAR sketches, whose contributions only exist pre-aggregated
+        # (capability table: decodes_per_contribution=False) — checked HERE
+        # (same policy as validate_compress): raised lazily inside the
+        # aggregation collective, it would be misread by the watchdog as a
+        # peer failure and silently degrade every host to standalone
+        # training.
+        from fedrec_tpu.comms import codec_caps
 
-        if not codec_decodes_per_contribution(cfg.fed.dcn_compress):
+        if not codec_caps(cfg.fed.dcn_compress).decodes_per_contribution:
             raise ValueError(
                 f"fed.robust.method={cfg.fed.robust.method!r} needs "
                 "per-contribution decode, which codec "
-                f"{cfg.fed.dcn_compress!r} cannot provide; use one of the "
+                f"{cfg.fed.dcn_compress!r} cannot provide (order statistics "
+                "judge CLIENTS, and sketch collisions mix every client's "
+                "coordinates before any decode exists); use one of the "
                 "decodable codecs (int8/sign1bit/topk) or "
                 "fed.robust.method='mean'"
             )
@@ -408,6 +420,8 @@ def main(argv: list[str] | None = None) -> int:
         robust=cfg.fed.robust,
         topk_ratio=cfg.fed.dcn_topk_ratio,
         error_feedback=cfg.fed.dcn_error_feedback,
+        sketch_width=cfg.fed.dcn_sketch_width,
+        sketch_seed=cfg.fed.dcn_sketch_seed,
         # cross-device round deadline: bound the round-end report gather
         # (fed.population.round_deadline_ms) so a straggling peer costs a
         # bounded wait, never a wedged run. NOTE this is a REAL wall-clock

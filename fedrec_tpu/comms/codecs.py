@@ -44,6 +44,31 @@ Codecs:
                largest-|x| coordinates per tensor (index + value pairs).
                ``ratio = fed.dcn_topk_ratio``; ~``1/(2*ratio)``× the wire.
                Biased — requires error feedback.
+``countsketch`` — LINEAR sketch: each tensor flattens into an
+               ``m = ceil(width * n)`` bucket array via a seeded hash
+               ``h : [n] -> [m]`` and sign ``s : [n] -> {±1}``
+               (``y[h(i)] += s(i) * x[i]``); decode is ``x̂_i = s(i) *
+               y[h(i)]`` — unbiased (``E[x̂] = x`` over the hash draw,
+               colliding coordinates carry independent random signs),
+               per-coordinate variance ~ ``(‖x‖² - x_i²)/m``. Because
+               encode/decode are LINEAR maps sharing one seeded hash,
+               ``decode(Σ encode(x_c)) == Σ x̂_c`` EXACTLY — a summing
+               aggregation server (or the async buffer) can reduce
+               sketches it cannot decode per contribution.
+``randproj`` — LINEAR seeded random projection: the flat tensor is
+               processed in 256-wide chunks, each projected by a shared
+               ``±1/√d`` matrix ``R`` (``d = ceil(width * 256)``);
+               decode is ``y @ Rᵀ`` — unbiased (``E[R Rᵀ] = I``),
+               denser error than count-sketch (every coordinate takes a
+               little noise) but no collision hot spots.
+
+Both sketches decode AFTER the sum (arXiv 2405.20431's aggregated end of
+the design space; the Smart-NIC wire-format constraint of arXiv
+2307.06561): the wire only ever carries fixed-size linear images, so a
+dumb summing device can do the reduce. The price: a per-contribution
+decode does not exist once summed, so order statistics (trimmed mean /
+median) cannot compose — the capability table below is where every
+dispatch site learns that boundary.
 
 DP ordering contract: per-example clipping and noise happen inside the
 train step, *before* any encode ever sees the update — the codec compresses
@@ -59,10 +84,61 @@ from typing import Any
 
 import numpy as np
 
-CODECS = ("none", "int8", "sign1bit", "topk")
+CODECS = ("none", "int8", "sign1bit", "topk", "countsketch", "randproj")
+
+# sketch geometry defaults (fed.dcn_sketch_width / fed.dcn_sketch_seed)
+DEFAULT_SKETCH_WIDTH = 0.1
+DEFAULT_SKETCH_SEED = 0
+# randproj chunk: flat tensors project 256 coordinates at a time through a
+# shared (256, d) matrix — a full (n, m) matrix would be O(n²·width) memory
+_RP_CHUNK = 256
+
+
+@dataclass(frozen=True)
+class CodecCaps:
+    """The codec capability contract every dispatch site consults.
+
+    ``decodes_per_contribution`` — each contribution can densify BEFORE
+    any reduction (decode-before-reduce): the property that makes robust
+    aggregation (trimmed mean / median / clip) legal, because order
+    statistics judge CLIENTS and cannot run over summed sketches.
+    ``is_linear`` — ``decode(Σ encode(x_c)) == Σ decode(encode(x_c))``:
+    the property that makes SUM-THEN-DECODE legal (one decode at the
+    root; the async buffer folds in sketch space).
+    ``supports_error_feedback`` — the codec's bias is worth banking a
+    per-client residual for (``fed.dcn_error_feedback``); unbiased
+    codecs (int8 rounding, the sketches) carry none.
+    """
+
+    decodes_per_contribution: bool
+    is_linear: bool
+    supports_error_feedback: bool
+
+
+CODEC_CAPS: dict[str, CodecCaps] = {
+    # "none" is trivially linear: identity commutes with the sum
+    "none": CodecCaps(True, True, False),
+    "int8": CodecCaps(True, False, False),
+    "sign1bit": CodecCaps(True, False, True),
+    "topk": CodecCaps(True, False, True),
+    "countsketch": CodecCaps(False, True, False),
+    "randproj": CodecCaps(False, True, False),
+}
+assert set(CODEC_CAPS) == set(CODECS)
+
 # codecs whose reconstruction error is biased (sign flips / dropped mass):
 # these carry per-client error-feedback residuals when fed.dcn_error_feedback
-EF_CODECS = ("sign1bit", "topk")
+EF_CODECS = tuple(
+    c for c in CODECS if CODEC_CAPS[c].supports_error_feedback
+)
+# linear sketches: encode into fixed-size images a summing server reduces
+LINEAR_SKETCH_CODECS = tuple(
+    c for c in CODECS if not CODEC_CAPS[c].decodes_per_contribution
+)
+# the single payload-dict key each linear sketch rides under — the async
+# buffer stores the raw array as an entry leaf and rebuilds the payload
+# dict around this key at decode time
+SKETCH_PAYLOAD_KEY = {"countsketch": "sketch", "randproj": "proj"}
 
 
 def validate_codec(name: str) -> str:
@@ -77,8 +153,18 @@ def validate_codec(name: str) -> str:
     return name
 
 
+def codec_caps(codec: str) -> CodecCaps:
+    """The capability row for ``codec`` (validates the name)."""
+    validate_codec(codec)
+    return CODEC_CAPS[codec]
+
+
 def codec_uses_feedback(codec: str, error_feedback: bool = True) -> bool:
-    """True when this codec keeps per-client error-feedback residuals."""
+    """True when this codec keeps per-client error-feedback residuals.
+    ``auto`` (the adaptive per-layer mode) conservatively allocates them:
+    its pinned map may include EF codecs on some leaves."""
+    if codec == "auto":
+        return error_feedback
     return error_feedback and codec in EF_CODECS
 
 
@@ -86,11 +172,49 @@ def codec_decodes_per_contribution(codec: str) -> bool:
     """True when each contribution can be decoded to a dense tensor BEFORE
     any reduction — the property that makes robust aggregation (trimmed
     mean / median / clip) legal with this codec (decode-before-reduce).
-    Every registered codec has it; an aggregated sketch (e.g. a summed
-    count-sketch, or in-network aggregation à la the Smart-NIC offload)
-    would not, and is where the robust×compress fail-fast lives."""
-    validate_codec(codec)
-    return True
+    The sketches (countsketch / randproj) lack it: their contributions
+    only exist pre-aggregated, which is where the robust×compress
+    fail-fast lives. Delegates to :data:`CODEC_CAPS`."""
+    return codec_caps(codec).decodes_per_contribution
+
+
+def sketch_dims(size: int, width: float) -> int:
+    """Sketch buckets for an ``size``-element tensor at ``width``
+    (``fed.dcn_sketch_width``): ``ceil(width * size)``, at least 1, at
+    most the tensor size (a sketch wider than the tensor is the tensor)."""
+    if not 0.0 < width <= 1.0:
+        raise ValueError(
+            f"fed.dcn_sketch_width must be in (0, 1], got {width}"
+        )
+    return max(1, min(int(size), int(np.ceil(width * float(size)))))
+
+
+def _sketch_hashes(
+    seed: int, leaf_id: int, n: int, m: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The count-sketch hash ``h : [n] -> [m]`` and sign ``s : [n] -> ±1``
+    for one leaf. Derived ONLY from (seed, leaf_id, n, m), so every
+    client/process/worker sharing the config derives the SAME maps — the
+    precondition for summing sketches across contributions."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed) & 0x7FFFFFFF, int(leaf_id), n, m])
+    )
+    h = rng.integers(0, m, size=n, dtype=np.int64)
+    s = (rng.integers(0, 2, size=n).astype(np.float32) * 2.0 - 1.0)
+    return h, s
+
+
+def _randproj_matrix(seed: int, leaf_id: int, d: int) -> np.ndarray:
+    """The shared per-leaf (``_RP_CHUNK``, d) projection with iid
+    ``±1/√d`` entries: ``E[R Rᵀ] = I`` makes ``decode = y @ Rᵀ``
+    unbiased. Same (seed, leaf_id, d) → same matrix on every client."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence(
+            [(int(seed) + 1) & 0x7FFFFFFF, int(leaf_id), _RP_CHUNK, d]
+        )
+    )
+    signs = rng.integers(0, 2, size=(_RP_CHUNK, d)).astype(np.float32)
+    return (signs * 2.0 - 1.0) / np.float32(np.sqrt(d))
 
 
 def topk_count(size: int, ratio: float) -> int:
@@ -104,14 +228,42 @@ def topk_count(size: int, ratio: float) -> int:
 
 
 # ------------------------------------------------------------ numpy (wire)
-def encode_leaf(x: np.ndarray, codec: str, topk_ratio: float = 0.01) -> dict:
+def encode_leaf(
+    x: np.ndarray,
+    codec: str,
+    topk_ratio: float = 0.01,
+    *,
+    sketch_width: float = DEFAULT_SKETCH_WIDTH,
+    sketch_seed: int = DEFAULT_SKETCH_SEED,
+    leaf_id: int = 0,
+) -> dict:
     """One tensor → its wire payload: a flat dict of numpy arrays (a valid
     pytree, so payloads travel through ``process_allgather`` unchanged).
     The payload is everything that crosses the wire; shapes/dtypes are
-    host-side metadata both ends already hold (the model config)."""
+    host-side metadata both ends already hold (the model config).
+
+    The sketch codecs key their shared hash/projection on
+    ``(sketch_seed, leaf_id)`` — both ends must agree on the leaf's index
+    in the flattened tree for the payloads to sum."""
     x = np.asarray(x, np.float32)
     if codec == "none":
         return {"dense": x}
+    if codec == "countsketch":
+        flat = x.reshape(-1)
+        n = flat.size
+        m = sketch_dims(max(n, 1), sketch_width)
+        h, s = _sketch_hashes(sketch_seed, leaf_id, n, m)
+        y = np.bincount(h, weights=(s * flat).astype(np.float64), minlength=m)
+        return {"sketch": y.astype(np.float32)}
+    if codec == "randproj":
+        flat = x.reshape(-1)
+        n = flat.size
+        d = sketch_dims(_RP_CHUNK, sketch_width)
+        nchunks = max(1, -(-n // _RP_CHUNK))
+        pad = nchunks * _RP_CHUNK - n
+        xp = np.pad(flat, (0, pad)).reshape(nchunks, _RP_CHUNK)
+        y = xp @ _randproj_matrix(sketch_seed, leaf_id, d)
+        return {"proj": y.astype(np.float32)}
     if codec == "int8":
         amax = float(np.max(np.abs(x))) if x.size else 0.0
         scale = np.float32(amax / 127.0)
@@ -135,10 +287,33 @@ def encode_leaf(x: np.ndarray, codec: str, topk_ratio: float = 0.01) -> dict:
     raise ValueError(f"unknown codec {codec!r}")  # pragma: no cover
 
 
-def decode_leaf(payload: dict, codec: str, shape: tuple) -> np.ndarray:
-    """Wire payload → dense float32 tensor of ``shape``."""
+def decode_leaf(
+    payload: dict,
+    codec: str,
+    shape: tuple,
+    *,
+    sketch_seed: int = DEFAULT_SKETCH_SEED,
+    leaf_id: int = 0,
+) -> np.ndarray:
+    """Wire payload → dense float32 tensor of ``shape``.
+
+    For the linear sketches this is itself a LINEAR map, so it works
+    unchanged on a SUMMED payload: ``decode_leaf(Σ sketches)`` IS the
+    decode-after-sum step (one decode at the root, no per-contribution
+    densify)."""
     if codec == "none":
         return np.asarray(payload["dense"], np.float32).reshape(shape)
+    if codec == "countsketch":
+        y = np.asarray(payload["sketch"], np.float32)
+        n = int(np.prod(shape)) if shape else 1
+        h, s = _sketch_hashes(sketch_seed, leaf_id, n, y.shape[0])
+        return (s * y[h]).astype(np.float32).reshape(shape)
+    if codec == "randproj":
+        y = np.asarray(payload["proj"], np.float32)
+        n = int(np.prod(shape)) if shape else 1
+        r = _randproj_matrix(sketch_seed, leaf_id, y.shape[-1])
+        flat = (y @ r.T).reshape(-1)[:n]
+        return flat.astype(np.float32).reshape(shape)
     if codec == "int8":
         return payload["q"].astype(np.float32) * np.float32(payload["scale"])
     if codec == "sign1bit":
@@ -165,28 +340,71 @@ def payload_nbytes(payload: dict) -> int:
 @dataclass
 class EncodedTree:
     """One contribution, encoded: the wire pytree plus the host-side
-    metadata needed to decode any process's copy of it."""
+    metadata needed to decode any process's copy of it.
+
+    ``leaf_codecs`` (when set) is the per-leaf codec map pinned by
+    ``fed.dcn_compress=auto`` — one codec name per flattened leaf,
+    overriding the tree-wide ``codec`` label. ``sketch_width`` /
+    ``sketch_seed`` are the shared sketch geometry; every endpoint must
+    hold the same pair for payloads to sum."""
 
     codec: str
     payloads: list          # per-leaf payload dicts — the wire pytree
     shapes: list            # per-leaf dense shapes (host metadata)
     treedef: Any
+    leaf_codecs: list | None = None
+    sketch_width: float = DEFAULT_SKETCH_WIDTH
+    sketch_seed: int = DEFAULT_SKETCH_SEED
+
+    def leaf_codec(self, i: int) -> str:
+        return self.codec if self.leaf_codecs is None else self.leaf_codecs[i]
 
     def nbytes(self) -> int:
         return int(sum(payload_nbytes(p) for p in self.payloads))
 
 
-def encode_tree(tree: Any, codec: str, topk_ratio: float = 0.01) -> EncodedTree:
+def encode_tree(
+    tree: Any,
+    codec: str,
+    topk_ratio: float = 0.01,
+    *,
+    sketch_width: float = DEFAULT_SKETCH_WIDTH,
+    sketch_seed: int = DEFAULT_SKETCH_SEED,
+    leaf_codecs: list | None = None,
+) -> EncodedTree:
     import jax
 
-    validate_codec(codec)
     flat, treedef = jax.tree_util.tree_flatten(tree)
     flat = [np.asarray(x, np.float32) for x in flat]
+    if leaf_codecs is None:
+        validate_codec(codec)
+        per_leaf = [codec] * len(flat)
+    else:
+        if len(leaf_codecs) != len(flat):
+            raise ValueError(
+                f"per-leaf codec map has {len(leaf_codecs)} entries but the "
+                f"tree has {len(flat)} leaves — stale fed.dcn_compress=auto "
+                "map for this model config?"
+            )
+        per_leaf = [validate_codec(c) for c in leaf_codecs]
     return EncodedTree(
         codec=codec,
-        payloads=[encode_leaf(x, codec, topk_ratio) for x in flat],
+        payloads=[
+            encode_leaf(
+                x,
+                c,
+                topk_ratio,
+                sketch_width=sketch_width,
+                sketch_seed=sketch_seed,
+                leaf_id=i,
+            )
+            for i, (x, c) in enumerate(zip(flat, per_leaf))
+        ],
         shapes=[x.shape for x in flat],
         treedef=treedef,
+        leaf_codecs=list(leaf_codecs) if leaf_codecs is not None else None,
+        sketch_width=sketch_width,
+        sketch_seed=sketch_seed,
     )
 
 
@@ -194,7 +412,14 @@ def decode_tree(enc: EncodedTree) -> Any:
     import jax
 
     leaves = [
-        decode_leaf(p, enc.codec, s) for p, s in zip(enc.payloads, enc.shapes)
+        decode_leaf(
+            p,
+            enc.leaf_codec(i),
+            s,
+            sketch_seed=enc.sketch_seed,
+            leaf_id=i,
+        )
+        for i, (p, s) in enumerate(zip(enc.payloads, enc.shapes))
     ]
     return jax.tree_util.tree_unflatten(enc.treedef, leaves)
 
@@ -205,22 +430,39 @@ def decode_gathered(gathered_payloads: list, enc: EncodedTree) -> Any:
     dense ``(P, *shape)`` float32 stacks: exactly what
     ``robust_reduce_tree_np`` (or a weighted mean) consumes. THE
     decode-before-reduce step: each contribution is densified per process
-    before any cross-process reduction sees it."""
+    before any cross-process reduction sees it. Only legal for leaves whose
+    codec ``decodes_per_contribution``; the coordinator routes linear
+    sketch leaves through :func:`sum_payloads` + ONE :func:`decode_leaf`
+    instead."""
     import jax
 
     leaves = []
-    for payload, shape in zip(gathered_payloads, enc.shapes):
+    for i, (payload, shape) in enumerate(zip(gathered_payloads, enc.shapes)):
         num_p = int(np.asarray(next(iter(payload.values()))).shape[0])
         rows = [
             decode_leaf(
                 {k: np.asarray(v)[p] for k, v in payload.items()},
-                enc.codec,
+                enc.leaf_codec(i),
                 shape,
+                sketch_seed=enc.sketch_seed,
+                leaf_id=i,
             )
             for p in range(num_p)
         ]
         leaves.append(np.stack(rows))
     return jax.tree_util.tree_unflatten(enc.treedef, leaves)
+
+
+def sum_payloads(payload: dict, coeffs: np.ndarray) -> dict:
+    """Coefficient-weighted sum of one leaf's allgathered payload over its
+    leading (P,) process dim — the SUM-THEN-DECODE reduce for a linear
+    sketch leaf. Runs entirely in sketch space: what a dumb summing device
+    (or the async buffer) does without ever holding a dense tensor."""
+    c = np.asarray(coeffs, np.float32)
+    return {
+        k: np.tensordot(c, np.asarray(v, np.float32), axes=(0, 0))
+        for k, v in payload.items()
+    }
 
 
 def tree_dense_nbytes(tree: Any) -> int:
@@ -232,19 +474,85 @@ def tree_dense_nbytes(tree: Any) -> int:
     )
 
 
+def leaf_names(tree: Any) -> list:
+    """Stable short names for the flattened leaves of ``tree`` (key paths
+    joined with '/'), used as the ``leaf=`` label of the per-layer
+    compression telemetry and as the keys of the pinned ``auto`` codec
+    map. Deterministic given the tree structure — every process derives
+    the same names."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for path, _leaf in flat:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "name"):
+                parts.append(str(p.name))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:  # pragma: no cover - exotic pytree nodes
+                parts.append(str(p))
+        names.append("/".join(parts) if parts else "param")
+    return names
+
+
+def tree_rmse(a: Any, b: Any) -> float:
+    """Root-mean-square reconstruction error between two pytrees, pooled
+    over every coordinate — the number behind ``fed.dcn_sketch_rmse``."""
+    import jax
+
+    sq, n = 0.0, 0
+    for xa, xb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        d = np.asarray(xa, np.float64) - np.asarray(xb, np.float64)
+        sq += float(np.sum(d * d))
+        n += int(d.size)
+    return float(np.sqrt(sq / max(n, 1)))
+
+
 # ----------------------------------------------------- jax (in-graph twin)
-def jax_encode_decode(x, codec: str, topk_ratio: float = 0.01):
+def jax_encode_decode(
+    x,
+    codec: str,
+    topk_ratio: float = 0.01,
+    *,
+    sketch_width: float = DEFAULT_SKETCH_WIDTH,
+    sketch_seed: int = DEFAULT_SKETCH_SEED,
+    leaf_id: int = 0,
+):
     """Encode→decode one tensor INSIDE a jitted program: the arithmetic
     twin of ``decode_leaf(encode_leaf(x))``, expressed in jnp so the
     round-end sync can compress per-client updates without leaving the
     compiled round. Same scales, same round-half-to-even, same top-k
-    tie-break as the numpy wire codec (pinned in tests/test_comms.py)."""
+    tie-break as the numpy wire codec (pinned in tests/test_comms.py).
+    The sketch hashes/projections are trace-time numpy constants keyed on
+    (sketch_seed, leaf_id, shape) — identical to the wire codec's, so the
+    in-graph simulation and a real sketch round share reconstructions."""
     import jax
     import jax.numpy as jnp
 
     xf = jnp.asarray(x, jnp.float32)
     if codec == "none":
         return xf
+    if codec == "countsketch":
+        flat = xf.reshape(-1)
+        n = int(flat.shape[0])
+        m = sketch_dims(max(n, 1), sketch_width)
+        h, s = _sketch_hashes(sketch_seed, leaf_id, n, m)
+        hj, sj = jnp.asarray(h), jnp.asarray(s)
+        y = jnp.zeros((m,), jnp.float32).at[hj].add(sj * flat)
+        return (sj * y[hj]).reshape(xf.shape)
+    if codec == "randproj":
+        flat = xf.reshape(-1)
+        n = int(flat.shape[0])
+        d = sketch_dims(_RP_CHUNK, sketch_width)
+        r = jnp.asarray(_randproj_matrix(sketch_seed, leaf_id, d))
+        nchunks = max(1, -(-n // _RP_CHUNK))
+        xp = jnp.pad(flat, (0, nchunks * _RP_CHUNK - n))
+        xhat = (xp.reshape(nchunks, _RP_CHUNK) @ r) @ r.T
+        return xhat.reshape(-1)[:n].reshape(xf.shape)
     if codec == "int8":
         amax = jnp.max(jnp.abs(xf))
         scale = amax / 127.0

@@ -367,19 +367,44 @@ class FedConfig:
     #   "sign1bit" — 1 bit/coord + per-tensor scale (~32x); needs EF
     #   "topk"     — keep the dcn_topk_ratio largest coords (~1/(2*ratio)x);
     #                needs EF
-    # Every codec decodes per contribution BEFORE any reduction, so robust
-    # aggregation (fed.robust.method) composes with all of them
-    # (decode-before-reduce — trimmed mean judges clients, not
-    # quantization noise).
-    dcn_compress: str = "none"         # "none" | "int8" | "sign1bit" | "topk"
+    #   "countsketch" — LINEAR seeded count-sketch, ceil(width * n) buckets
+    #                per tensor (~1/width x); unbiased, decodes AFTER the
+    #                sum (one decode at the root)
+    #   "randproj" — LINEAR seeded ±1/√d random projection in 256-wide
+    #                chunks (~1/width x); unbiased, decodes AFTER the sum
+    #   "auto"     — adaptive per-leaf selection: a seeded warmup window
+    #                measures per-tensor reconstruction, then pins a
+    #                per-leaf codec map (sketch for dense towers, topk for
+    #                sparse deltas, none for scalars) recorded in
+    #                provenance and held fixed for replayability
+    # The per-contribution codecs (int8/sign1bit/topk) decode each
+    # contribution BEFORE any reduction, so robust aggregation
+    # (fed.robust.method) composes with them (decode-before-reduce). The
+    # linear sketches only decode after the sum — order statistics don't
+    # commute with sketch collision, so robust non-mean methods fail fast
+    # (the capability table in fedrec_tpu.comms marks the boundary).
+    dcn_compress: str = "none"  # none|int8|sign1bit|topk|countsketch|randproj|auto
     # topk: fraction of coordinates kept per tensor (ceil(ratio * n), >= 1)
     dcn_topk_ratio: float = 0.01
+    # linear sketches: sketch-to-dense size ratio in (0, 1] — wire cost is
+    # ~width * dense bytes, reconstruction variance ~ ||x||^2 * width / m.
+    # 0.1 → ~10x uplink reduction (the banked comm_cost contract is >= 8x).
+    dcn_sketch_width: float = 0.1
+    # seed for the shared sketch hash/projection: every client, process and
+    # async worker must hold the SAME seed for sketches to sum.
+    dcn_sketch_seed: int = 0
+    # dcn_compress="auto": rounds observed (with the sync running dense)
+    # before the per-leaf codec map is pinned. The map derives from the
+    # warmup round's global delta, identical on every process.
+    dcn_auto_warmup: int = 1
     # per-client error-feedback residuals for the biased codecs
     # (sign1bit/topk): the mass a lossy encode drops is carried in
     # ClientState.ef_residual (a fed.population sidecar field — LRU/spill,
     # checkpointed, reset on quarantine heal) and re-enters the next
     # round's update. Disable only for ablations: biased codecs without EF
     # are known not to converge (EF-signSGD, Karimireddy et al. 2019).
+    # Async wire workers bank the same residual per EDGE (worker id),
+    # keyed to the global version the push was based on.
     dcn_error_feedback: bool = True
     # Byzantine-robust aggregation + quarantine/rollback recovery (see
     # RobustConfig). Applies wherever params aggregate: the in-graph

@@ -519,6 +519,41 @@ def build_report(
             ratio = snapshot_value(last, "fed.dcn_compression_ratio")
             if ratio:
                 comm["compression_ratio"] = ratio
+            else:
+                # explicit "codec: none": this artifact moved DENSE
+                # traffic — absent-because-uncompressed, not
+                # absent-because-unmeasured (operators diffing two
+                # reports must see which side ran a codec)
+                comm["codec"] = "none"
+            per_leaf = {
+                row["labels"].get("leaf", "?"): row["value"]
+                for row in _metric_values(
+                    last, "fed.dcn_compression_ratio_leaf"
+                )
+                if "value" in row
+            }
+            if per_leaf:
+                comm["compression_ratio_by_leaf"] = per_leaf
+            srmse = snapshot_value(last, "fed.dcn_sketch_rmse")
+            if srmse is not None:
+                comm["sketch_rmse"] = srmse
+            auto_map = next(
+                (
+                    r["dcn_auto_map_pinned"]
+                    for r in reversed(records)
+                    if "dcn_auto_map_pinned" in r
+                ),
+                None,
+            )
+            if isinstance(auto_map, str):
+                # the trainer logs the map as a JSON string (the metric
+                # logger stringifies anything non-numeric)
+                try:
+                    auto_map = json.loads(auto_map)
+                except json.JSONDecodeError:
+                    auto_map = None
+            if isinstance(auto_map, dict) and auto_map:
+                comm["auto_codec_map"] = auto_map
             misses = snapshot_value(last, "fed.dcn_deadline_misses_total")
             if misses:
                 comm["deadline_misses"] = misses
@@ -793,6 +828,27 @@ def render_text(report: dict) -> str:
                 f"update compression: {comm['compression_ratio']:.1f}x "
                 "(dense/encoded, per client-round payload)"
             )
+        else:
+            lines.append("codec: none (dense payloads — no compression ran)")
+        if "compression_ratio_by_leaf" in comm:
+            cells = ", ".join(
+                f"{leaf}={v:.1f}x"
+                for leaf, v in sorted(
+                    comm["compression_ratio_by_leaf"].items()
+                )
+            )
+            lines.append(f"per-layer compression: {cells}")
+        if "sketch_rmse" in comm:
+            lines.append(
+                f"sketch reconstruction rmse: {comm['sketch_rmse']:.3e} "
+                "(own decoded contribution vs dense, pooled)"
+            )
+        if "auto_codec_map" in comm:
+            picks = ", ".join(
+                f"{leaf}:{c}"
+                for leaf, c in sorted(comm["auto_codec_map"].items())
+            )
+            lines.append(f"auto codec map (pinned): {picks}")
         if "deadline_misses" in comm:
             lines.append(f"dcn deadline misses: {int(comm['deadline_misses'])}")
         lines.append("")

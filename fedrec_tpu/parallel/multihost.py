@@ -316,6 +316,8 @@ def aggregate_from_hosts(
     topk_ratio: float = 0.01,
     error_feedback: bool = True,
     agg: Any = None,
+    sketch_width: float = 0.1,
+    sketch_seed: int = 0,
 ) -> Any:
     """Participation-weighted FedAvg across processes.
 
@@ -333,13 +335,17 @@ def aggregate_from_hosts(
     per-round CONTRIBUTIONS adds bounded reconstruction error to the mean,
     and the biased codecs bank that error per process via ``codec_state``).
 
-    DECODE-BEFORE-REDUCE: every gathered contribution is densified
-    per-process before ANY reduction, so ``robust`` (a ``fed.robust``
-    section with ``method != "mean"``) composes with every codec —
-    trimmed-mean/median judge clients, not quantization noise. The only
-    remaining fail-fast is a codec that cannot decode per contribution
-    (an aggregated sketch; none registered —
-    :func:`fedrec_tpu.comms.codec_decodes_per_contribution`).
+    DECODE-BEFORE-REDUCE vs SUM-THEN-DECODE: the per-contribution codecs
+    (int8/sign1bit/topk) densify every gathered contribution per process
+    before ANY reduction, so ``robust`` (a ``fed.robust`` section with
+    ``method != "mean"``) composes with them — trimmed-mean/median judge
+    clients, not quantization noise. The LINEAR sketches (countsketch /
+    randproj) take the other branch: the allgather ships fixed-size
+    sketch images, the weighted sum runs in sketch space, and ONE decode
+    happens at the root (``decode(Σ enc(xᵢ)) == Σ x̂ᵢ`` by linearity).
+    That branch is mean-only — a summed sketch has no per-contribution
+    decode, so order statistics fail fast (the capability table in
+    :mod:`fedrec_tpu.comms` marks the boundary).
 
     ``base``: a pytree every process holds identically — the round-start
     global from the server fan-out. With a codec active the round DELTAS
@@ -414,24 +420,32 @@ def aggregate_from_hosts(
 
         validate_robust_method(method)
         if compress != "none":
-            from fedrec_tpu.comms import codec_decodes_per_contribution
+            from fedrec_tpu.comms import codec_caps
 
-            if not codec_decodes_per_contribution(compress):
+            if not codec_caps(compress).decodes_per_contribution:
                 raise ValueError(
                     f"fed.robust.method={method!r} needs per-contribution "
                     f"decode, which codec {compress!r} cannot provide (its "
-                    "contributions only exist pre-aggregated); use one of "
-                    "the decodable codecs (int8/sign1bit/topk) or "
-                    "fed.robust.method='mean'"
+                    "contributions only exist pre-aggregated: order "
+                    "statistics like trimmed-mean/median judge CLIENTS, and "
+                    "sketch collisions mix every client's coordinates before "
+                    "any decode exists); use one of the decodable codecs "
+                    "(int8/sign1bit/topk) or fed.robust.method='mean'"
                 )
 
     if compress != "none":
         from fedrec_tpu.comms import (
+            codec_caps,
             codec_uses_feedback,
             decode_gathered,
+            decode_leaf,
             decode_tree,
             encode_tree,
+            leaf_names,
+            payload_nbytes,
+            sum_payloads,
             tree_dense_nbytes,
+            tree_rmse,
         )
 
         raw = jax.tree_util.tree_map(
@@ -451,12 +465,19 @@ def aggregate_from_hosts(
             )
         else:
             acc = contrib
-        enc = encode_tree(acc, compress, topk_ratio)
+        enc = encode_tree(
+            acc, compress, topk_ratio,
+            sketch_width=sketch_width, sketch_seed=sketch_seed,
+        )
         own_decoded = decode_tree(enc)
         if use_ef and codec_state is not None and float(w_arr) > 0:
             codec_state.residual = jax.tree_util.tree_map(
                 lambda a, d: a - d, acc, own_decoded
             )
+        any_sketch = any(
+            not codec_caps(enc.leaf_codec(i)).decodes_per_contribution
+            for i in range(len(enc.payloads))
+        )
         # ONE collective for payload + weight: fewer DCN round trips, and
         # no window where a peer death strands the runtime between
         # matched gathers
@@ -466,28 +487,81 @@ def aggregate_from_hosts(
             dense=tree_dense_nbytes(acc),
             encoded=enc.nbytes(),
         )
+        from fedrec_tpu.obs import get_registry
+
+        reg = get_registry()
+        ratio_leaf = reg.gauge(
+            "fed.dcn_compression_ratio_leaf",
+            "dense/encoded byte ratio of one round-update tensor, by leaf",
+            labels=("leaf",),
+        )
+        for name, payload, shape in zip(
+            leaf_names(acc), enc.payloads, enc.shapes
+        ):
+            dense_b = 4 * int(np.prod(shape)) if shape else 4
+            enc_b = max(payload_nbytes(payload), 1)
+            ratio_leaf.set(dense_b / enc_b, leaf=name)
+        if any_sketch:
+            # measured reconstruction error of THIS process's own sketch
+            # round-trip — the live signal an operator tunes
+            # fed.dcn_sketch_width against (docs/OPERATIONS.md §3d)
+            reg.gauge(
+                "fed.dcn_sketch_rmse",
+                "RMSE of this process's sketch round-trip (decode(encode(x))"
+                " vs x), pooled over all sketched coordinates",
+            ).set(tree_rmse(own_decoded, acc))
         total = float(np.sum(weights))
         if total == 0.0:
             return params  # nobody reported; keep local (no NaNs)
-        stacks = decode_gathered(gathered, enc)  # leaves: (P, *shape) dense
         w_np = np.asarray(weights)
         if method != "mean":
+            # all leaves decodable here (the sketch fail-fast above):
             # m==0 coordinates keep this host's own decoded
             # contribution (the in-graph fallback contract)
+            stacks = decode_gathered(gathered, enc)  # (P, *shape) dense
             reduced = _robust_reduce(stacks, w_np, own_decoded)
         else:
             coeff = (np.where(w_np > 0, w_np, 0.0) / total).astype(np.float32)
+            mask_p = w_np > 0
 
-            def _masked_mean(s):
+            def _mask_rows(v):
                 # zero-WEIGHT contributions are masked out of the sum, not
-                # multiplied in: a quarantined process's NaN decode must
+                # multiplied in: a quarantined process's NaN payload must
                 # contribute nothing, not NaN (weighted_param_avg parity)
-                mask = (w_np > 0).reshape((-1,) + (1,) * (s.ndim - 1))
-                return np.einsum(
-                    "p,p...->...", coeff, np.where(mask, s, 0.0)
-                )
+                a = np.asarray(v, np.float32)
+                m = mask_p.reshape((-1,) + (1,) * (a.ndim - 1))
+                return np.where(m, a, 0.0)
 
-            reduced = jax.tree_util.tree_map(_masked_mean, stacks)
+            out_leaves = []
+            for i, (payload, shape) in enumerate(
+                zip(gathered, enc.shapes)
+            ):
+                lc = enc.leaf_codec(i)
+                masked = {k: _mask_rows(v) for k, v in payload.items()}
+                if not codec_caps(lc).decodes_per_contribution:
+                    # SUM-THEN-DECODE: weighted mean in sketch space,
+                    # ONE decode at the root — by linearity this IS the
+                    # mean of the per-contribution decodes
+                    summed = sum_payloads(masked, coeff)
+                    out_leaves.append(
+                        decode_leaf(
+                            summed, lc, shape,
+                            sketch_seed=enc.sketch_seed, leaf_id=i,
+                        )
+                    )
+                else:
+                    rows = np.stack([
+                        decode_leaf(
+                            {k: v[p] for k, v in masked.items()},
+                            lc, shape,
+                            sketch_seed=enc.sketch_seed, leaf_id=i,
+                        )
+                        for p in range(len(w_np))
+                    ])
+                    out_leaves.append(
+                        np.einsum("p,p...->...", coeff, rows)
+                    )
+            reduced = jax.tree_util.tree_unflatten(enc.treedef, out_leaves)
         if base is not None:
             reduced = jax.tree_util.tree_map(
                 lambda m, b: m + np.asarray(b, np.float32), reduced, base
@@ -562,6 +636,8 @@ class CoordinatorRuntime:
         membership: Any = None,
         epoch: int = 0,
         agg: Any = None,
+        sketch_width: float = 0.1,
+        sketch_seed: int = 0,
     ):
         self.process_id = jax.process_index()
         self.num_processes = jax.process_count()
@@ -586,6 +662,8 @@ class CoordinatorRuntime:
         self.agg = agg  # agg section; hierarchical = per-tier robust reduce
         self.topk_ratio = topk_ratio
         self.error_feedback = error_feedback
+        self.sketch_width = sketch_width
+        self.sketch_seed = sketch_seed
         # this process's error-feedback residual for the biased codecs
         # (sign1bit/topk): the wire endpoint's EF state, persisted by the
         # coordinator CLI at save cadence so a resumed run keeps carrying
@@ -728,6 +806,8 @@ class CoordinatorRuntime:
                 robust=self.robust, codec_state=self.codec_state,
                 topk_ratio=self.topk_ratio,
                 error_feedback=self.error_feedback, agg=self.agg,
+                sketch_width=self.sketch_width,
+                sketch_seed=self.sketch_seed,
             ),
             lambda: params,
             timeout_s=deadline if deadline else None,

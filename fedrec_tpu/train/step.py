@@ -1365,7 +1365,7 @@ def compressed_sync_active(cfg: ExperimentConfig, strategy: FedStrategy) -> bool
 
 def _make_local_sync(
     strategy: FedStrategy, sync_axes: Any, robust: Any = None,
-    fed_cfg: Any = None,
+    fed_cfg: Any = None, leaf_codecs: list | None = None,
 ) -> Callable:
     """THE round-end parameter-sync body — shared by ``build_param_sync``
     (host-driven rounds) and ``build_fed_round_scan`` (rounds-in-jit) so
@@ -1399,20 +1399,30 @@ def _make_local_sync(
          post-sync global in any participating round); a round where no
          client reports keeps local params, the ``weighted_param_avg``
          contract.
+
+    ``leaf_codecs`` (``fed.dcn_compress='auto'``): a pinned per-leaf codec
+    map — one concrete codec per flattened leaf of the ``(user, news)``
+    contribution tree, overriding the tree-wide codec. Error feedback then
+    applies PER LEAF, only where the leaf's codec supports it (the
+    capability table); sketch leaves stay unbiased and bank nothing.
     """
     method = getattr(robust, "method", "mean") if robust is not None else "mean"
     codec = getattr(fed_cfg, "dcn_compress", "none") if fed_cfg is not None else "none"
     if codec != "none" and strategy.sync_params_every_round:
         from fedrec_tpu.comms import (
+            codec_caps,
             codec_uses_feedback,
             jax_encode_decode,
             validate_codec,
         )
         from fedrec_tpu.fed.strategies import weighted_param_avg
 
-        validate_codec(codec)
+        if leaf_codecs is None and codec != "auto":
+            validate_codec(codec)
         use_ef = codec_uses_feedback(codec, fed_cfg.dcn_error_feedback)
         ratio = fed_cfg.dcn_topk_ratio
+        sk_width = getattr(fed_cfg, "dcn_sketch_width", 0.1)
+        sk_seed = getattr(fed_cfg, "dcn_sketch_seed", 0)
         if method != "mean":
             from fedrec_tpu.fed.robust import (
                 robust_aggregate,
@@ -1428,25 +1438,55 @@ def _make_local_sync(
                 lambda t, e: t.astype(jnp.float32) - e.astype(jnp.float32),
                 theta, entry,
             )
-            if use_ef:
-                residual = state.ef_residual
-                acc = jax.tree_util.tree_map(
-                    lambda d, r: d + r, delta, residual
-                )
-            else:
-                acc = delta
-            decoded = jax.tree_util.tree_map(
-                lambda x: jax_encode_decode(x, codec, ratio), acc
+            flat_d, treedef = jax.tree_util.tree_flatten(delta)
+            # codec="auto" with no pinned map yet = the warmup window:
+            # an all-"none" map (dense sync through the codec program
+            # shape, so the later pin only swaps leaf constants)
+            tree_wide = "none" if codec == "auto" else codec
+            per_leaf = (
+                [tree_wide] * len(flat_d)
+                if leaf_codecs is None
+                else [validate_codec(c) for c in leaf_codecs]
             )
-            new_residual = None
-            if use_ef:
-                # a weight-0 client transmitted nothing this round: its
-                # residual carries over unchanged (its delta is discarded
-                # with its participation, not banked)
-                new_residual = jax.tree_util.tree_map(
-                    lambda a, d, r: jnp.where(w > 0, a - d, r),
-                    acc, decoded, residual,
+            if len(per_leaf) != len(flat_d):
+                raise ValueError(
+                    f"per-leaf codec map has {len(per_leaf)} entries but "
+                    f"the contribution tree has {len(flat_d)} leaves"
                 )
+            # EF applies per leaf, only where the leaf's codec is biased
+            # (supports_error_feedback); unbiased leaves bank nothing
+            ef_flags = [
+                use_ef and codec_caps(c).supports_error_feedback
+                for c in per_leaf
+            ]
+            flat_r = (
+                jax.tree_util.tree_leaves(state.ef_residual)
+                if use_ef
+                else [None] * len(flat_d)
+            )
+            decs, new_rs = [], []
+            for i, (d, c) in enumerate(zip(flat_d, per_leaf)):
+                a = d + flat_r[i] if ef_flags[i] else d
+                dec = jax_encode_decode(
+                    a, c, ratio,
+                    sketch_width=sk_width, sketch_seed=sk_seed, leaf_id=i,
+                )
+                decs.append(dec)
+                if use_ef:
+                    # a weight-0 client transmitted nothing this round:
+                    # its residual carries over unchanged (its delta is
+                    # discarded with its participation, not banked)
+                    new_rs.append(
+                        jnp.where(w > 0, a - dec, flat_r[i])
+                        if ef_flags[i]
+                        else flat_r[i]
+                    )
+            decoded = jax.tree_util.tree_unflatten(treedef, decs)
+            new_residual = (
+                jax.tree_util.tree_unflatten(treedef, new_rs)
+                if use_ef
+                else None
+            )
             if method != "mean":
                 agg = robust_aggregate(
                     decoded, w, sync_axes,
@@ -1502,6 +1542,7 @@ def build_param_sync(
     mesh: Mesh,
     strategy: FedStrategy | None = None,
     state_shardings: Any | None = None,
+    leaf_codecs: list | None = None,
 ) -> Callable:
     """Round-end parameter aggregation, dispatched through the strategy.
 
@@ -1515,7 +1556,9 @@ def build_param_sync(
     axis = cfg.fed.mesh_axis
     strategy = strategy or ParamAvg()
     k, sync_axes = cohort_axes(cfg, mesh)
-    local_sync = _make_local_sync(strategy, sync_axes, cfg.fed.robust, cfg.fed)
+    local_sync = _make_local_sync(
+        strategy, sync_axes, cfg.fed.robust, cfg.fed, leaf_codecs=leaf_codecs
+    )
 
     if compressed_sync_active(cfg, strategy):
         # codec body: ``sync(state, weights, entry_user, entry_news)`` —

@@ -142,10 +142,13 @@ class Trainer:
                 )
         # ---- update-compression codec (fed.dcn_compress, fedrec_tpu.comms):
         # validated up front like robust/server_opt — a codec that would
-        # silently never run is a misconfiguration, not a preference
-        from fedrec_tpu.comms import validate_codec
+        # silently never run is a misconfiguration, not a preference.
+        # "auto" is the adaptive per-leaf mode: a concrete codec per leaf
+        # is pinned from warmup telemetry (see _pin_auto_codec_map).
+        from fedrec_tpu.comms import codec_caps, validate_codec
 
-        validate_codec(cfg.fed.dcn_compress)
+        if cfg.fed.dcn_compress != "auto":
+            validate_codec(cfg.fed.dcn_compress)
         if (
             cfg.fed.dcn_compress != "none"
             and not self.strategy.sync_params_every_round
@@ -165,6 +168,50 @@ class Trainer:
                 "the full-size residency shard.fsdp exists to avoid — use "
                 "int8/sign1bit or shard.fsdp=1"
             )
+        if (
+            rb.method != "mean"
+            and cfg.fed.dcn_compress not in ("none", "auto")
+            and not codec_caps(cfg.fed.dcn_compress).decodes_per_contribution
+        ):
+            raise ValueError(
+                f"fed.robust.method={rb.method!r} needs per-contribution "
+                f"decode, which codec {cfg.fed.dcn_compress!r} cannot "
+                "provide (its contributions only exist pre-aggregated: "
+                "order statistics judge CLIENTS, and sketch collisions mix "
+                "every client's coordinates before any decode exists); use "
+                "one of the decodable codecs (int8/sign1bit/topk) or "
+                "fed.robust.method='mean'"
+            )
+        if cfg.fed.dcn_compress == "auto":
+            if cfg.train.rounds_per_scan > 1:
+                raise ValueError(
+                    "fed.dcn_compress='auto' is incompatible with "
+                    "train.rounds_per_scan > 1: pinning the per-leaf codec "
+                    "map after warmup rebuilds the compiled sync, which "
+                    "cannot happen inside a compiled round chain"
+                )
+            if rb.method != "mean":
+                raise ValueError(
+                    "fed.dcn_compress='auto' requires "
+                    "fed.robust.method='mean': the pinned per-leaf map may "
+                    "select a linear sketch, whose contributions only exist "
+                    "pre-aggregated (no per-contribution decode for order "
+                    "statistics)"
+                )
+            if cfg.fed.dcn_auto_warmup < 1:
+                raise ValueError(
+                    f"fed.dcn_auto_warmup={cfg.fed.dcn_auto_warmup} must "
+                    "be >= 1: the per-leaf map derives from at least one "
+                    "observed round delta"
+                )
+            if cfg.shard.fsdp > 1:
+                raise ValueError(
+                    "fed.dcn_compress='auto' with shard.fsdp>1 is not "
+                    "supported: the pinned map may select 'topk', which "
+                    "materializes every gathered dense delta at the sync "
+                    "boundary — pin a concrete fsdp-safe codec "
+                    "(int8/sign1bit/countsketch/randproj) instead"
+                )
         # ---- aggregation topology (agg.*, fedrec_tpu.agg): validated up
         # front like robust/codec — a mode that would silently never apply
         # is a misconfiguration, not a preference
@@ -202,13 +249,21 @@ class Trainer:
                     "is a host-side round-boundary operation and cannot run "
                     "inside a compiled round chain"
                 )
-            if cfg.fed.dcn_compress != "none":
+            if cfg.fed.dcn_compress == "auto":
                 raise ValueError(
-                    "agg.mode='async' does not yet compose with "
-                    "fed.dcn_compress: the buffered commit folds dense "
-                    "host-side deltas (compress the hierarchical mode's "
-                    "tiers instead, or keep agg.mode='flat')"
+                    "agg.mode='async' is incompatible with "
+                    "fed.dcn_compress='auto': buffered entries may outlive "
+                    "the warmup window, so the per-leaf map could change "
+                    "between a push and its fold — pin a concrete codec "
+                    "(every registered codec composes: linear sketches "
+                    "fold in sketch space, per-contribution codecs decode "
+                    "at push time with per-edge error feedback)"
                 )
+            # every CONCRETE codec composes with the buffered commit —
+            # the capability table says how: is_linear folds in sketch
+            # space under the same staleness weights; otherwise
+            # decodes_per_contribution decodes at push time (per-edge EF
+            # residuals ride the buffer sidecar)
         # the host-side tiered reduce only engages for NON-linear robust
         # methods: a tree of (sum(w*x), sum(w)) partials with one final
         # divide IS the flat weighted mean algebraically, so
@@ -503,6 +558,11 @@ class Trainer:
             self.model, cfg, self.mesh, self.strategy,
             state_shardings=self._state_shardings,
         )
+        # fed.dcn_compress="auto": until the warmup window pins the real
+        # map, the codec-sync body runs with an all-"none" map (dense sync
+        # through the codec program SHAPE, so the pin only swaps leaf
+        # constants, never the calling convention) — _make_local_sync
+        # derives that warmup default from codec="auto" + leaf_codecs=None
         self.param_sync = build_param_sync(
             cfg, self.mesh, self.strategy,
             state_shardings=self._state_shardings,
@@ -1025,23 +1085,12 @@ class Trainer:
         )
         self._codec_bytes_per_client: int | None = None
         self._dense_bytes_per_client: int | None = None
-        if cfg.fed.dcn_compress != "none":
-            from fedrec_tpu.comms import encode_tree, tree_dense_nbytes
-
-            host_params = jax.tree_util.tree_map(
-                np.asarray, self._client0_params()
-            )
-            enc = encode_tree(
-                host_params, cfg.fed.dcn_compress, cfg.fed.dcn_topk_ratio
-            )
-            # payload sizes are static per (codec, shapes): one real encode
-            # prices every round's uplink exactly
-            self._codec_bytes_per_client = enc.nbytes()
-            self._dense_bytes_per_client = tree_dense_nbytes(host_params)
-            self._g_comp_ratio.set(
-                self._dense_bytes_per_client
-                / max(self._codec_bytes_per_client, 1)
-            )
+        # fed.dcn_compress="auto": the per-leaf codec map, pinned once
+        # after the warmup window (None while warming up — the sync body
+        # runs with an all-"none" map until the pin, then recompiles)
+        self._auto_leaf_codecs: list | None = None
+        if cfg.fed.dcn_compress not in ("none", "auto"):
+            self._price_codec()
         # spent-epsilon trajectory: one gauge per round, next to loss/AUC.
         # Only the rigorous mechanism gets a trajectory — ldp_news carries
         # no (epsilon, delta) statement to spend against (docs/DP.md).
@@ -1239,6 +1288,156 @@ class Trainer:
         u = jax.tree_util.tree_map(lambda x: x[0], self.state.user_params)
         n = jax.tree_util.tree_map(lambda x: x[0], self.state.news_params)
         return u, n
+
+    def _price_codec(self) -> None:
+        """Price the per-client uplink from ONE real wire encode (payload
+        sizes are static per codec × shapes) and publish the overall +
+        per-leaf compression-ratio cells. Re-run when the ``auto``
+        per-leaf map pins (the payload sizes change with the map)."""
+        from fedrec_tpu.comms import (
+            encode_tree,
+            leaf_names,
+            payload_nbytes,
+            tree_dense_nbytes,
+        )
+
+        cfg = self.cfg
+        host_params = jax.tree_util.tree_map(
+            np.asarray, self._client0_params()
+        )
+        enc = encode_tree(
+            host_params,
+            cfg.fed.dcn_compress,
+            cfg.fed.dcn_topk_ratio,
+            sketch_width=cfg.fed.dcn_sketch_width,
+            sketch_seed=cfg.fed.dcn_sketch_seed,
+            leaf_codecs=self._auto_leaf_codecs,
+        )
+        self._codec_bytes_per_client = enc.nbytes()
+        self._dense_bytes_per_client = tree_dense_nbytes(host_params)
+        self._g_comp_ratio.set(
+            self._dense_bytes_per_client
+            / max(self._codec_bytes_per_client, 1)
+        )
+        ratio_leaf = self.registry.gauge(
+            "fed.dcn_compression_ratio_leaf",
+            "dense/encoded byte ratio of one round-update tensor, by leaf",
+            labels=("leaf",),
+        )
+        for name, payload, shape in zip(
+            leaf_names(host_params), enc.payloads, enc.shapes
+        ):
+            dense_b = 4 * int(np.prod(shape)) if shape else 4
+            ratio_leaf.set(
+                dense_b / max(payload_nbytes(payload), 1), leaf=name
+            )
+
+    # tensors at or below this size stay uncompressed under "auto":
+    # scalars/norm vectors, where codec overhead exceeds the dense bytes
+    _AUTO_DENSE_FLOOR = 64
+
+    def _pin_auto_codec_map(self, round_idx: int, sync_entry: Any) -> None:
+        """``fed.dcn_compress='auto'``: derive the per-leaf codec map from
+        the warmup window's GLOBAL round delta (round-entry global vs the
+        post-sync global — identical on every process, so the pin needs no
+        broadcast and replays deterministically from the seed), rebuild
+        the compiled sync around it, re-price the uplink, and record the
+        map in provenance (``codec_map.json`` beside the obs artifacts).
+
+        Selection per leaf: tensors ≤ the dense floor stay "none"
+        (codec overhead exceeds the payload); otherwise the measured
+        reconstruction error of topk (at ``fed.dcn_topk_ratio``) and
+        countsketch (at ``fed.dcn_sketch_width``) on the warmup delta
+        decides — sparse, concentrated deltas reconstruct better under
+        topk; dense towers under the sketch. Held fixed thereafter."""
+        from fedrec_tpu.comms import decode_leaf, encode_leaf, leaf_names
+
+        cfg = self.cfg
+        entry0 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x[0], np.float32), sync_entry
+        )
+        post0 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32), self._client0_params()
+        )
+        delta = jax.tree_util.tree_map(lambda p, e: p - e, post0, entry0)
+        flat, _ = jax.tree_util.tree_flatten(delta)
+        names = leaf_names(delta)
+        chosen: list[str] = []
+        detail: list[dict] = []
+        for i, (name, d) in enumerate(zip(names, flat)):
+            if d.size <= self._AUTO_DENSE_FLOOR:
+                chosen.append("none")
+                detail.append({"leaf": name, "codec": "none", "n": int(d.size)})
+                continue
+            errs = {}
+            for cand in ("topk", "countsketch"):
+                rec = decode_leaf(
+                    encode_leaf(
+                        d, cand, cfg.fed.dcn_topk_ratio,
+                        sketch_width=cfg.fed.dcn_sketch_width,
+                        sketch_seed=cfg.fed.dcn_sketch_seed,
+                        leaf_id=i,
+                    ),
+                    cand, d.shape,
+                    sketch_seed=cfg.fed.dcn_sketch_seed, leaf_id=i,
+                )
+                errs[cand] = float(np.sqrt(np.mean((rec - d) ** 2)))
+            pick = "topk" if errs["topk"] <= errs["countsketch"] else "countsketch"
+            chosen.append(pick)
+            detail.append({
+                "leaf": name, "codec": pick, "n": int(d.size),
+                "rmse_topk": errs["topk"],
+                "rmse_countsketch": errs["countsketch"],
+            })
+        self._auto_leaf_codecs = chosen
+        # rebuild the compiled sync around the pinned map (same calling
+        # convention — the warmup body already ran the 4-arg codec shape)
+        from fedrec_tpu.train.step import build_param_sync
+
+        self.param_sync = self.watchdog.watch(
+            build_param_sync(
+                cfg, self.mesh, self.strategy,
+                state_shardings=self._state_shardings,
+                leaf_codecs=chosen,
+            ),
+            "param_sync",
+        )
+        self._price_codec()
+        summary = {
+            "pinned_at_round": int(round_idx),
+            "warmup_rounds": int(cfg.fed.dcn_auto_warmup),
+            "sketch_width": float(cfg.fed.dcn_sketch_width),
+            "sketch_seed": int(cfg.fed.dcn_sketch_seed),
+            "topk_ratio": float(cfg.fed.dcn_topk_ratio),
+            "map": {n: c for n, c in zip(names, chosen)},
+            "detail": detail,
+        }
+        import json
+
+        if self._obs_dir is not None:
+            with open(self._obs_dir / "codec_map.json", "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+        if self.logger is not None:
+            # a JSON string survives the logger's stringification — the
+            # report parses it back into the auto_codec_map row
+            self.logger.log(round_idx, {
+                "dcn_auto_map_pinned": json.dumps(
+                    {n: c for n, c in zip(names, chosen)}, sort_keys=True
+                ),
+            })
+        counts: dict[str, int] = {}
+        for c in chosen:
+            counts[c] = counts.get(c, 0) + 1
+        print(
+            f"[trainer] fed.dcn_compress=auto pinned per-leaf codec map "
+            f"after round {round_idx}: "
+            + ", ".join(f"{c}×{k}" for c, k in sorted(counts.items()))
+            + (
+                f" (codec_map.json in {self._obs_dir})"
+                if self._obs_dir is not None else ""
+            ),
+            flush=True,
+        )
 
     def _client_params(self, client: int) -> tuple[Any, Any]:
         u = jax.tree_util.tree_map(lambda x: x[client], self.state.user_params)
@@ -2475,6 +2674,13 @@ class Trainer:
                     self.state = self.param_sync(self.state, weights)
             self._m_robust_rounds.inc(method=cfg.fed.robust.method)
             self._count_uplink(weights_np)
+            if (
+                cfg.fed.dcn_compress == "auto"
+                and self._auto_leaf_codecs is None
+                and sync_entry is not None
+                and round_idx + 1 >= cfg.fed.dcn_auto_warmup
+            ):
+                self._pin_auto_codec_map(round_idx, sync_entry)
             if self.server_opt is not None:
                 # FedOpt: the weighted mean is a proposal, not the new model —
                 # the server optimizer steps the global from round_start
@@ -2587,7 +2793,7 @@ class Trainer:
         the buffer to fold staleness-weighted into the next commit — the
         cohort-simulation twin of the agg/server.py wire deployment."""
         from fedrec_tpu.agg.buffer import BufferEntry
-        from fedrec_tpu.agg.commit import fold_commit
+        from fedrec_tpu.agg.commit import encode_contribution, fold_commit
         from fedrec_tpu.fed.chaos import population_report
 
         cfg = self.cfg
@@ -2607,17 +2813,46 @@ class Trainer:
         quorum_lat = float(latency[order[k - 1]])
         max_lat = float(latency[order[-1]])
 
+        codec = cfg.fed.dcn_compress
+
         def entry(slot: int) -> BufferEntry:
+            wid = str(int(client_ids[slot]))
+            leaves = [
+                np.asarray(s[slot] - b)
+                for s, b in zip(stack_leaves, base_leaves)
+            ]
+            ecodec = "none"
+            if codec != "none":
+                # per-contribution codecs decode at push with this
+                # edge's banked error-feedback residual (riding the
+                # buffer sidecar, so it survives checkpoint/restore);
+                # linear sketches buffer raw and fold in sketch space
+                banked = (
+                    self.agg_buffer.residual_for(wid)
+                    if cfg.fed.dcn_error_feedback
+                    else None
+                )
+                leaves, ecodec, new_res, _ = encode_contribution(
+                    leaves,
+                    codec,
+                    topk_ratio=cfg.fed.dcn_topk_ratio,
+                    sketch_width=cfg.fed.dcn_sketch_width,
+                    sketch_seed=cfg.fed.dcn_sketch_seed,
+                    residual_leaves=banked,
+                )
+                if new_res is not None and cfg.fed.dcn_error_feedback:
+                    self.agg_buffer.bank_residual(
+                        wid, self._agg_version, new_res
+                    )
             return BufferEntry(
-                worker=str(int(client_ids[slot])),
+                worker=wid,
                 round=round_idx,
                 epoch=self.agg_buffer.epoch,
                 based_on=self._agg_version,
                 weight=float(weights_np[slot]),
                 arrival_ms=float(latency[slot]),
-                leaves=[
-                    s[slot] - b for s, b in zip(stack_leaves, base_leaves)
-                ],
+                leaves=leaves,
+                codec=ecodec,
             )
 
         # prior rounds' stragglers fold into THIS commit (staleness >= 1)
@@ -2637,6 +2872,7 @@ class Trainer:
             method=cfg.fed.robust.method,
             trim_k=cfg.fed.robust.trim_k,
             clip_norm=cfg.fed.robust.clip_norm,
+            sketch_seed=cfg.fed.dcn_sketch_seed,
         )
         self._agg_version = stats.version
         for e in late_entries:

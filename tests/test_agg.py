@@ -496,7 +496,13 @@ def test_trainer_rejects_bad_agg_config(agg_data, tmp_path):
         "rounds_per_scan",
         **{"agg.mode": "async", "train.rounds_per_scan": 2},
     )
+    # every CONCRETE codec composes with async now (entries are encoded
+    # into the buffer); only the warmup-dependent "auto" stays rejected
     expect(
-        "dcn_compress",
-        **{"agg.mode": "async", "fed.dcn_compress": "int8"},
+        "dcn_compress='auto'",
+        **{"agg.mode": "async", "fed.dcn_compress": "auto"},
     )
+    cfg = _agg_cfg(tmp_path, "guard_codec_ok")
+    cfg.agg.mode = "async"
+    cfg.fed.dcn_compress = "int8"
+    Trainer(cfg, data, tok)   # must NOT raise

@@ -634,7 +634,8 @@ def test_aggregate_from_hosts_none_is_exact_single_process():
 def test_aggregate_from_hosts_robust_composes_with_codec():
     """Pre-PR this raised; now trimmed_mean + int8 runs (P=1: decode own
     contribution, trim degenerates to it) — the fail-fast survives only
-    for non-decodable codecs, which none of the registered ones are."""
+    for non-decodable codecs (the linear sketches, pinned in
+    test_sketch_codecs.py::test_aggregate_from_hosts_robust_rejects_sketch)."""
     from fedrec_tpu.config import RobustConfig
     from fedrec_tpu.parallel.multihost import aggregate_from_hosts
 

@@ -226,3 +226,58 @@ def test_report_has_no_robustness_section_when_counters_zero(tmp_path):
     reg.write_snapshot(jsonl)
     _, snapshots = load_jsonl(jsonl)
     assert "robustness" not in build_report([], snapshots)
+
+def test_report_communication_codec_none_is_explicit(tmp_path):
+    """A codec-less artifact (dense DCN traffic, no compression ratio)
+    must render an EXPLICIT "codec: none" row — operators diffing two
+    reports need "uncompressed" distinguishable from "unmeasured"."""
+    from fedrec_tpu.obs.report import render_text
+
+    reg = MetricsRegistry()
+    reg.counter("fed.dcn_bytes_up_total", labels=("path",)).inc(
+        4 << 20, path="dcn"
+    )
+    jsonl = tmp_path / "metrics.jsonl"
+    reg.write_snapshot(jsonl)
+    records, snapshots = load_jsonl(jsonl)
+    comm = build_report(records, snapshots)["communication"]
+    assert comm["codec"] == "none"
+    assert "compression_ratio" not in comm
+    text = render_text(build_report(records, snapshots))
+    assert "codec: none" in text
+
+
+def test_report_communication_renders_sketch_telemetry(tmp_path):
+    """With a codec active: the per-layer compression cells, the sketch
+    reconstruction RMSE, and the pinned auto codec map all render in the
+    Communication section."""
+    from fedrec_tpu.obs.report import render_text
+
+    reg = MetricsRegistry()
+    reg.counter("fed.dcn_bytes_up_total", labels=("path",)).inc(
+        1 << 20, path="dcn"
+    )
+    reg.gauge("fed.dcn_compression_ratio").set(9.6)
+    leaf = reg.gauge("fed.dcn_compression_ratio_leaf", labels=("leaf",))
+    leaf.set(10.0, leaf="user/attn/w")
+    leaf.set(1.0, leaf="user/bias")
+    reg.gauge("fed.dcn_sketch_rmse").set(3.25e-3)
+    jsonl = tmp_path / "metrics.jsonl"
+    logger = MetricLogger(jsonl_path=str(jsonl))
+    logger.log(1, {"dcn_auto_map_pinned": json.dumps(
+        {"user/attn/w": "countsketch", "user/bias": "none"}
+    )})
+    logger.finish()
+    reg.write_snapshot(jsonl)
+    records, snapshots = load_jsonl(jsonl)
+    comm = build_report(records, snapshots)["communication"]
+    assert comm["compression_ratio"] == 9.6
+    assert "codec" not in comm
+    assert comm["compression_ratio_by_leaf"]["user/attn/w"] == 10.0
+    assert comm["sketch_rmse"] == 3.25e-3
+    assert comm["auto_codec_map"]["user/attn/w"] == "countsketch"
+    text = render_text(build_report(records, snapshots))
+    assert "per-layer compression" in text
+    assert "user/attn/w=10.0x" in text
+    assert "sketch reconstruction rmse" in text
+    assert "user/attn/w:countsketch" in text

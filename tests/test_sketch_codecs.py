@@ -1,0 +1,692 @@
+"""Linear sketch codecs + aggregated-end decode (ISSUE 17).
+
+Pins the sum-then-decode subsystem end to end:
+
+* the codec capability table is total over the registry and drives the
+  dispatch semantics (per-contribution decode vs decode-after-sum);
+* countsketch/randproj round-trip unbiasedness (pooled over seeds,
+  against the analytic collision variance) and the width knob's error
+  ordering (wider sketch => lower error);
+* LINEARITY, the property everything rides on:
+  ``decode(Σ c_i * encode(x_i)) == Σ c_i * decode(encode(x_i))``, with
+  the weighted sum running through :func:`sum_payloads` in sketch space;
+* the in-graph jnp twin implements the same arithmetic as the wire codec;
+* the coordinator DCN path folds sketches SUM-THEN-DECODE (one decode),
+  and robust methods fail fast against sketch codecs (order statistics
+  need per-contribution deltas);
+* the async buffer folds sketch entries in sketch space, matching
+  decode-then-fold within float tolerance under staleness weights;
+* per-edge error-feedback residuals survive a staleness-reordered fold
+  AND a buffer checkpoint/restore across a membership-epoch change;
+* async + top-k with per-edge EF converges on the hand-checkable
+  quadratic where EF-less top-k stalls bit-exactly (the ISSUE 7 pin,
+  extended to the staleness-reordered fold);
+* the commit authority accepts encoded pushes: per-contribution codecs
+  densify at push time, sketches buffer raw, robust servers reject
+  sketch pushes at the wire.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedrec_tpu.comms import (
+    CODEC_CAPS,
+    CODECS,
+    LINEAR_SKETCH_CODECS,
+    SKETCH_PAYLOAD_KEY,
+    codec_caps,
+    codec_decodes_per_contribution,
+    codec_uses_feedback,
+    decode_leaf,
+    decode_tree,
+    encode_leaf,
+    encode_tree,
+    jax_encode_decode,
+    payload_nbytes,
+    sketch_dims,
+    sum_payloads,
+    tree_rmse,
+)
+
+from fedrec_tpu.agg.buffer import AggBuffer, BufferEntry
+from fedrec_tpu.agg.commit import (
+    CommitPolicy,
+    encode_contribution,
+    fold_commit,
+    staleness_weight,
+)
+
+SKETCHES = list(LINEAR_SKETCH_CODECS)
+
+
+def _tensor(shape, seed=0, scale=3.0):
+    x = np.random.default_rng(seed).standard_normal(shape)
+    return (x * scale).astype(np.float32)
+
+
+# ===================================================== capability table
+def test_capability_table_is_total_and_drives_dispatch():
+    """Every registered codec has a capability row, and the table is the
+    single source of the three dispatch decisions: per-contribution
+    decode, linear decode-after-sum, and error-feedback banking."""
+    assert set(CODEC_CAPS) == set(CODECS)
+    assert set(SKETCHES) == {"countsketch", "randproj"}
+    for c in SKETCHES:
+        caps = codec_caps(c)
+        assert caps.is_linear and not caps.decodes_per_contribution
+        assert not caps.supports_error_feedback
+        assert not codec_decodes_per_contribution(c)
+        assert c in SKETCH_PAYLOAD_KEY
+    for c in ("none", "int8", "sign1bit", "topk"):
+        assert codec_decodes_per_contribution(c)
+    # "auto" allocates EF state conservatively: the pinned map may
+    # include EF codecs, so a requested error_feedback must stick
+    assert codec_uses_feedback("auto", True) is True
+    assert codec_uses_feedback("countsketch", True) is False
+    assert codec_uses_feedback("topk", True) is True
+
+
+def test_sketch_dims_width_contract():
+    assert sketch_dims(1000, 0.1) == 100
+    assert sketch_dims(3, 0.1) == 1          # floor at 1 row
+    assert sketch_dims(10, 1.0) == 10        # never wider than the input
+    with pytest.raises(ValueError):
+        sketch_dims(10, 0.0)
+    with pytest.raises(ValueError):
+        sketch_dims(10, 1.5)
+
+
+# ============================================= round-trip + width bounds
+@pytest.mark.parametrize("codec", SKETCHES)
+def test_sketch_roundtrip_unbiased_over_seeds(codec):
+    """The sketch estimate is unbiased: averaging decode(encode(x)) over
+    independent hash seeds converges to x at the analytic collision-
+    variance rate.  Pooled RMSE of the seed-mean stays within 4x the
+    predicted standard error (fixed seed set — deterministic, no flake)."""
+    x = _tensor((256,), seed=5, scale=1.0)
+    width, seeds = 0.25, 64
+    acc = np.zeros_like(x, np.float64)
+    for s in range(seeds):
+        p = encode_leaf(x, codec, sketch_width=width, sketch_seed=s)
+        acc += decode_leaf(p, codec, x.shape, sketch_seed=s)
+    mean = acc / seeds
+    # per-coordinate estimator variance ~ ||x||^2 / m (collision mass)
+    m = sketch_dims(x.size, width)
+    pred_se = float(np.sqrt(np.sum(x.astype(np.float64) ** 2) / m / seeds))
+    rmse = float(np.sqrt(np.mean((mean - x) ** 2)))
+    assert rmse < 4.0 * pred_se, (rmse, pred_se)
+
+
+@pytest.mark.parametrize("codec", SKETCHES)
+def test_sketch_error_shrinks_with_width(codec):
+    """The fed.dcn_sketch_width knob trades bytes for error: a 4x wider
+    sketch costs 4x the bytes and strictly beats the narrow one's
+    reconstruction error on the same tensor."""
+    x = _tensor((512,), seed=7)
+    errs, bytes_ = {}, {}
+    for width in (0.05, 0.4):
+        p = encode_leaf(x, codec, sketch_width=width, sketch_seed=1)
+        d = decode_leaf(p, codec, x.shape, sketch_seed=1)
+        errs[width] = float(np.sqrt(np.mean((d - x) ** 2)))
+        bytes_[width] = payload_nbytes(p)
+    assert errs[0.4] < errs[0.05]
+    assert bytes_[0.4] > bytes_[0.05]
+    assert bytes_[0.05] <= 0.06 * x.nbytes   # ~20x compression at 0.05
+
+
+# ======================================================= LINEARITY pins
+@pytest.mark.parametrize("codec", SKETCHES)
+def test_decode_after_sum_equals_sum_of_decodes(codec):
+    """THE tentpole identity: one decode of the coefficient-weighted
+    sketch sum equals the weighted sum of per-contribution decodes.
+    The weighted sum runs through sum_payloads — pure sketch-space
+    arithmetic, exactly what a summing coordinator does."""
+    xs = [_tensor((33, 5), seed=i) for i in range(4)]
+    coeffs = np.asarray([0.5, 1.25, 0.0, 2.0], np.float32)
+    payloads = [
+        encode_leaf(x, codec, sketch_width=0.2, sketch_seed=9, leaf_id=3)
+        for x in xs
+    ]
+    gathered = {
+        k: np.stack([p[k] for p in payloads], axis=0)
+        for k in payloads[0]
+    }
+    summed = sum_payloads(gathered, coeffs)
+    one_decode = decode_leaf(
+        summed, codec, xs[0].shape, sketch_seed=9, leaf_id=3
+    )
+    many = sum(
+        c * decode_leaf(p, codec, xs[0].shape, sketch_seed=9, leaf_id=3)
+        for c, p in zip(coeffs, payloads)
+    )
+    np.testing.assert_allclose(one_decode, many, atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("codec", SKETCHES)
+def test_jax_twin_matches_wire_sketch(codec):
+    x = _tensor((19, 7), seed=3)
+    wire = decode_leaf(
+        encode_leaf(x, codec, sketch_width=0.3, sketch_seed=2, leaf_id=4),
+        codec, x.shape, sketch_seed=2, leaf_id=4,
+    )
+    twin = np.asarray(
+        jax_encode_decode(
+            x, codec, sketch_width=0.3, sketch_seed=2, leaf_id=4
+        )
+    )
+    np.testing.assert_allclose(twin, wire, atol=1e-5, rtol=1e-5)
+
+
+def test_sketch_payloads_share_geometry_across_processes():
+    """Two processes encoding DIFFERENT tensors at the same (seed,
+    leaf_id) produce same-shape payloads (summable), and the decode of
+    the sum approximates the sum of inputs — the DCN allgather
+    contract."""
+    a, b = _tensor((64,), seed=1), _tensor((64,), seed=2)
+    for codec in SKETCHES:
+        pa = encode_leaf(a, codec, sketch_width=0.5, sketch_seed=0)
+        pb = encode_leaf(b, codec, sketch_width=0.5, sketch_seed=0)
+        k = SKETCH_PAYLOAD_KEY[codec]
+        assert pa[k].shape == pb[k].shape
+        dec = decode_leaf(
+            {k: pa[k] + pb[k]}, codec, a.shape, sketch_seed=0
+        )
+        # one-decode reconstruction of a+b within the sketch error bound
+        target = a + b
+        rel = np.sqrt(np.mean((dec - target) ** 2)) / np.sqrt(
+            np.mean(target**2)
+        )
+        assert rel < 2.5  # width 0.5 on n=64: noisy, but not garbage
+        assert np.corrcoef(dec, target)[0, 1] > 0.5
+
+
+# ===================================== coordinator path: sum-then-decode
+def test_aggregate_from_hosts_sketch_sum_then_decode_single_process():
+    """P=1 world: the sketch branch returns base + decode(encode(delta))
+    — numerically identical to encode_tree/decode_tree with the same
+    seed/leaf ids — and banks the sketch RMSE gauge."""
+    from fedrec_tpu.obs import MetricsRegistry, set_registry
+    from fedrec_tpu.parallel.multihost import aggregate_from_hosts
+
+    reg = MetricsRegistry()
+    set_registry(reg)
+    params = {
+        "u": _tensor((24, 4), seed=21),
+        "n": _tensor((9,), seed=22),
+    }
+    base = jax.tree_util.tree_map(lambda x: x * 0.95, params)
+    delta = jax.tree_util.tree_map(
+        lambda p, b: np.asarray(p) - np.asarray(b), params, base
+    )
+    for codec in SKETCHES:
+        out = aggregate_from_hosts(
+            params, weight=1.0, compress=codec, base=base,
+            sketch_width=0.5, sketch_seed=4,
+        )
+        expect = jax.tree_util.tree_map(
+            lambda b, d: np.asarray(b) + np.asarray(d),
+            base,
+            decode_tree(
+                encode_tree(
+                    delta, codec, sketch_width=0.5, sketch_seed=4
+                )
+            ),
+        )
+        for o, e in zip(
+            jax.tree_util.tree_leaves(out),
+            jax.tree_util.tree_leaves(expect),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(o), np.asarray(e), atol=1e-4, rtol=1e-4
+            )
+    g = reg.gauge("fed.dcn_sketch_rmse")
+    assert g.value() is not None and g.value() > 0.0
+
+
+def test_aggregate_from_hosts_robust_rejects_sketch():
+    """Order statistics judge CLIENTS; a sketch's contributions only
+    exist pre-aggregated — the guard names the codec and the way out."""
+    from fedrec_tpu.config import RobustConfig
+    from fedrec_tpu.parallel.multihost import aggregate_from_hosts
+
+    robust = RobustConfig()
+    robust.method = "trimmed_mean"
+    params = {"u": _tensor((4,), seed=1)}
+    for codec in SKETCHES:
+        with pytest.raises(
+            ValueError, match="needs per-contribution decode"
+        ):
+            aggregate_from_hosts(
+                params, weight=1.0, compress=codec, robust=robust,
+                base=jax.tree_util.tree_map(np.zeros_like, params),
+            )
+
+
+# ========================================= async buffer: sketch folding
+def _mk_entry(worker, based_on, leaves, codec="none", weight=1.0, rnd=0):
+    return BufferEntry(
+        worker=worker, round=rnd, epoch=0, based_on=based_on,
+        weight=weight, arrival_ms=0.0, leaves=leaves, codec=codec,
+    )
+
+
+def test_async_sketch_fold_matches_decode_then_fold():
+    """Sketch entries fold IN SKETCH SPACE with 1/(1+staleness) weights;
+    by linearity the single decode per commit equals decoding every
+    contribution first and folding dense — within float tolerance."""
+    rng = np.random.default_rng(0)
+    base = [
+        rng.normal(size=(40, 8)).astype(np.float32),
+        rng.normal(size=(17,)).astype(np.float32),
+    ]
+    version, seed = 2, 3
+    entries, decoded = [], []
+    for i, w in enumerate("abc"):
+        delta = [rng.normal(size=b.shape).astype(np.float32) for b in base]
+        leaves, ecodec, res, nbytes = encode_contribution(
+            delta, "countsketch", sketch_width=0.25, sketch_seed=seed
+        )
+        assert ecodec == "countsketch" and res is None
+        assert 0 < nbytes < sum(d.nbytes for d in delta)
+        entries.append(_mk_entry(w, based_on=version - i, leaves=leaves,
+                                 codec=ecodec))
+        decoded.append(
+            (
+                [
+                    decode_leaf(
+                        {SKETCH_PAYLOAD_KEY["countsketch"]: l},
+                        "countsketch", b.shape,
+                        sketch_seed=seed, leaf_id=j,
+                    )
+                    for j, (l, b) in enumerate(zip(leaves, base))
+                ],
+                version - i,
+            )
+        )
+    out, stats = fold_commit(
+        base, entries, version, CommitPolicy(staleness_cap=5),
+        sketch_seed=seed,
+    )
+    assert stats.folded == 3 and stats.late_folds == 2
+    wts = [staleness_weight(version - b) for _, b in decoded]
+    total = sum(wts)
+    for j, b in enumerate(base):
+        ref = np.asarray(b, np.float64) + sum(
+            w * np.asarray(d[j], np.float64)
+            for (d, _), w in zip(decoded, wts)
+        ) / total
+        np.testing.assert_allclose(
+            np.asarray(out[j], np.float64), ref, atol=1e-5
+        )
+
+
+def test_async_mixed_dense_and_sketch_entries_share_one_mean():
+    """A buffer holding dense AND sketch entries still folds to a single
+    weighted mean: the dense contribution exact, the sketch contribution
+    within its reconstruction error."""
+    base = [np.zeros((60,), np.float32)]
+    d_dense = [_tensor((60,), seed=31, scale=1.0)]
+    d_sketch = [_tensor((60,), seed=32, scale=1.0)]
+    sk, ec, _, _ = encode_contribution(
+        d_sketch, "countsketch", sketch_width=0.5, sketch_seed=0
+    )
+    out, stats = fold_commit(
+        base,
+        [
+            _mk_entry("a", 0, [x.copy() for x in d_dense]),
+            _mk_entry("b", 0, sk, codec=ec),
+        ],
+        0,
+        CommitPolicy(staleness_cap=2),
+        sketch_seed=0,
+    )
+    assert stats.folded == 2
+    dec = decode_leaf(
+        {SKETCH_PAYLOAD_KEY["countsketch"]: sk[0]}, "countsketch",
+        (60,), sketch_seed=0,
+    )
+    ref = (d_dense[0].astype(np.float64) + dec.astype(np.float64)) / 2.0
+    np.testing.assert_allclose(
+        np.asarray(out[0], np.float64), ref, atol=1e-5
+    )
+
+
+def test_async_robust_fold_rejects_sketch_entries():
+    base = [np.zeros((8,), np.float32)]
+    sk, ec, _, _ = encode_contribution(
+        [_tensor((8,), seed=1)], "randproj", sketch_width=0.5
+    )
+    with pytest.raises(ValueError, match="cannot fold sketch-coded"):
+        fold_commit(
+            base, [_mk_entry("a", 0, sk, codec=ec)], 0,
+            CommitPolicy(staleness_cap=2), method="median",
+        )
+
+
+# ============================== per-edge EF residuals on the async edge
+def test_encode_contribution_decode_at_push_with_residual():
+    """Per-contribution codecs densify at push: decoded + residual
+    reconstructs the accumulated delta EXACTLY, and the next push folds
+    the banked residual back in (the EF telescope)."""
+    delta = [_tensor((30,), seed=41), _tensor((5, 4), seed=42)]
+    leaves, ec, res, _ = encode_contribution(delta, "topk", topk_ratio=0.1)
+    assert ec == "none" and res is not None
+    for l, r, d in zip(leaves, res, delta):
+        np.testing.assert_allclose(l + r, d, atol=1e-6)
+    # second push: residual rides in, so cumulative transmission
+    # telescopes — sum of two decodes + final residual == sum of deltas
+    delta2 = [_tensor((30,), seed=43), _tensor((5, 4), seed=44)]
+    leaves2, _, res2, _ = encode_contribution(
+        delta2, "topk", topk_ratio=0.1, residual_leaves=res
+    )
+    for l1, l2, r2, d1, d2 in zip(leaves, leaves2, res2, delta, delta2):
+        np.testing.assert_allclose(l1 + l2 + r2, d1 + d2, atol=1e-5)
+    # int8 has no EF support: decodes dense, banks nothing
+    _, ec8, res8, _ = encode_contribution(delta, "int8")
+    assert ec8 == "none" and res8 is None
+
+
+def test_ef_residual_survives_staleness_reorder_and_restore():
+    """The buffer banks per-edge residuals keyed by worker id, tagged
+    with the version the push was based on.  They survive (a) a
+    staleness-reordered fold — folding is weight arithmetic, residuals
+    are edge state, (b) the npz sidecar round-trip, (c) a membership-
+    epoch advance that kills OTHER workers; the dead worker's residual
+    dies with its entry."""
+    buf = AggBuffer(epoch=0)
+    base = [np.zeros((12,), np.float32)]
+    deltas = {w: [_tensor((12,), seed=50 + i)] for i, w in enumerate("ab")}
+    for based_on, w in [(1, "a"), (0, "b")]:      # b is one commit stale
+        leaves, ec, res, _ = encode_contribution(
+            deltas[w], "topk", topk_ratio=0.25,
+            residual_leaves=buf.residual_for(w),
+        )
+        buf.bank_residual(w, based_on, res)
+        buf.add(_mk_entry(w, based_on, leaves, codec=ec))
+    # staleness-reordered fold: stale entry folds at half weight, the
+    # banked residuals are untouched (they belong to the NEXT push)
+    out, stats = fold_commit(
+        base, buf.take_all(), 1, CommitPolicy(staleness_cap=2)
+    )
+    assert stats.late_folds == 1
+    assert buf.residual_for("a") is not None
+    assert buf.ef_residuals["b"]["based_on"] == 0
+    # sidecar round-trip preserves residuals bit-exactly
+    buf2, _, _ = AggBuffer.load_state(buf.state_bytes(3, 2))
+    for w in "ab":
+        np.testing.assert_array_equal(
+            buf2.residual_for(w)[0], buf.residual_for(w)[0]
+        )
+        assert (
+            buf2.ef_residuals[w]["based_on"]
+            == buf.ef_residuals[w]["based_on"]
+        )
+    # membership epoch change: the dead edge's residual goes with it
+    buf2.add(_mk_entry("a", 2, [np.ones((12,), np.float32)]))
+    dropped = buf2.advance_epoch(1, drop_dead={"a"})
+    assert dropped == 1
+    assert buf2.residual_for("a") is None
+    assert buf2.residual_for("b") is not None
+
+
+def test_pre_codec_sidecar_blob_still_loads():
+    """A v1 (pre-codec) sidecar has no codec tags and no residual
+    section: it must load as all-dense with an empty residual bank."""
+    import io
+    import json
+
+    meta = {
+        "magic": "fedrec-agg-buffer-v1", "round": 4, "version": 2,
+        "epoch": 1,
+        "entries": [{
+            "worker": "w0", "round": 4, "epoch": 1, "based_on": 2,
+            "weight": 1.0, "arrival_ms": 10.0, "num_leaves": 1,
+        }],
+    }
+    bio = io.BytesIO()
+    np.savez(
+        bio,
+        __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        e0_leaf0=np.ones(3, np.float32),
+    )
+    buf, rnd, ver = AggBuffer.load_state(bio.getvalue())
+    assert (rnd, ver) == (4, 2)
+    assert buf.entries[0].codec == "none"
+    assert buf.ef_residuals == {}
+
+
+# ============================ the stall pin, staleness-reordered (ISSUE 7+)
+def _async_quadratic(use_ef: bool, rounds: int = 400, lr: float = 0.05):
+    """The ISSUE 7 quadratic (dominating third coordinate, top-k k=1),
+    driven through the ASYNC fold: worker "a" pushes fresh, worker "b"
+    is permanently one commit stale, every commit folds both with
+    1/(1+s) weights.  Per-edge residuals ride the buffer."""
+    h = np.array([1.0, 1.0, 0.02], np.float32)
+    c = np.array([0.0, 0.0, 100.0], np.float32)
+    x = np.array([1.0, -1.0, 0.0], np.float32)
+    buf = AggBuffer()
+    version = 0
+    held = {"a": (0, x.copy()), "b": (0, x.copy())}
+    prev = (0, x.copy())
+    for r in range(rounds):
+        entries = []
+        for w in ("a", "b"):
+            based_on, xw = held[w]
+            delta = [(-lr * h * (xw - c)).astype(np.float32)]
+            leaves, ec, res, _ = encode_contribution(
+                delta, "topk", topk_ratio=1 / 3,
+                residual_leaves=buf.residual_for(w) if use_ef else None,
+            )
+            if use_ef and res is not None:
+                buf.bank_residual(w, based_on, res)
+            entries.append(
+                _mk_entry(w, based_on, leaves, codec=ec, rnd=r)
+            )
+        out, stats = fold_commit(
+            [x], entries, version, CommitPolicy(staleness_cap=3)
+        )
+        prev_x = x.copy()
+        x, version = np.asarray(out[0], np.float32), stats.version
+        held["a"] = (version, x.copy())
+        held["b"] = prev            # b adopts the PREVIOUS commit: stale
+        prev = (version, x.copy())
+        _ = prev_x
+    return x
+
+
+def test_async_topk_ef_converges_where_plain_stalls():
+    """EF-less top-k under the async fold: the dominating coordinate
+    wins the single slot every push from every edge, so coordinates 1-2
+    stall at EXACTLY their initial values.  Per-edge residuals unstick
+    them — through staleness-reordered folds and 1/(1+s) weights."""
+    plain = _async_quadratic(use_ef=False)
+    np.testing.assert_array_equal(plain[:2], [1.0, -1.0])   # bit-exact stall
+    ef = _async_quadratic(use_ef=True)
+    assert np.abs(ef[:2]).max() < 0.1                       # converged
+    assert plain[2] > 10 and ef[2] > 10                     # both descend
+
+
+# =============================================== commit authority (wire)
+def _mk_server(**kw):
+    from fedrec_tpu.agg.server import AggServer
+    from fedrec_tpu.obs import MetricsRegistry, set_registry
+
+    set_registry(MetricsRegistry())
+    defaults = dict(policy=CommitPolicy(quorum=2), world=2)
+    defaults.update(kw)
+    return AggServer(**defaults)
+
+
+def test_server_sketch_push_folds_in_sketch_space():
+    from fedrec_tpu.agg.server import encode_leaves, encode_payloads
+
+    srv = _mk_server(sketch_seed=6)
+    base = [np.zeros((50,), np.float32)]
+    srv.handle({"cmd": "init", "worker": "a", "payload": encode_leaves(base)})
+    deltas = {w: [_tensor((50,), seed=60 + i, scale=1.0)]
+              for i, w in enumerate("ab")}
+    for w in "ab":
+        payloads = [
+            encode_leaf(
+                x, "countsketch", sketch_width=0.5, sketch_seed=6,
+                leaf_id=j,
+            )
+            for j, x in enumerate(deltas[w])
+        ]
+        resp = srv.handle({
+            "cmd": "push", "worker": w, "round": 0, "based_on": 0,
+            "weight": 1.0, "codec": "countsketch",
+            "payload": encode_payloads(payloads),
+        })
+        assert "error" not in resp
+    assert srv.version == 1                      # quorum of 2 committed
+    dec = [
+        decode_leaf(
+            encode_leaf(
+                deltas[w][0], "countsketch", sketch_width=0.5,
+                sketch_seed=6, leaf_id=0,
+            ),
+            "countsketch", (50,), sketch_seed=6, leaf_id=0,
+        )
+        for w in "ab"
+    ]
+    ref = (dec[0].astype(np.float64) + dec[1].astype(np.float64)) / 2.0
+    np.testing.assert_allclose(
+        np.asarray(srv.global_leaves[0], np.float64), ref, atol=1e-5
+    )
+    from fedrec_tpu.obs import get_registry
+
+    c = get_registry().counter("agg.push_bytes_total", labels=("worker",))
+    assert c.value(worker="a") > 0
+
+
+def test_server_topk_push_densifies_at_push_time():
+    from fedrec_tpu.agg.server import encode_leaves, encode_payloads
+
+    srv = _mk_server(policy=CommitPolicy(quorum=3), world=3)
+    base = [np.zeros((20,), np.float32)]
+    srv.handle({"cmd": "init", "worker": "a", "payload": encode_leaves(base)})
+    delta = [_tensor((20,), seed=70)]
+    payloads = [
+        encode_leaf(x, "topk", 0.25, leaf_id=j)
+        for j, x in enumerate(delta)
+    ]
+    resp = srv.handle({
+        "cmd": "push", "worker": "a", "round": 0, "based_on": 0,
+        "weight": 1.0, "codec": "topk",
+        "payload": encode_payloads(payloads),
+    })
+    assert "error" not in resp and srv.version == 0   # below quorum
+    entry = srv.buffer.entries[0]
+    assert entry.codec == "none"                       # densified at push
+    np.testing.assert_allclose(
+        entry.leaves[0],
+        decode_leaf(payloads[0], "topk", (20,), leaf_id=0),
+        atol=1e-6,
+    )
+
+
+def test_server_robust_rejects_sketch_push_at_the_wire():
+    from fedrec_tpu.agg.server import encode_leaves, encode_payloads
+
+    srv = _mk_server(method="trimmed_mean")
+    base = [np.zeros((10,), np.float32)]
+    srv.handle({"cmd": "init", "worker": "a", "payload": encode_leaves(base)})
+    payloads = [
+        encode_leaf(
+            _tensor((10,), seed=2), "randproj", sketch_width=0.5, leaf_id=0
+        )
+    ]
+    resp = srv.handle({
+        "cmd": "push", "worker": "a", "round": 0, "based_on": 0,
+        "weight": 1.0, "codec": "randproj",
+        "payload": encode_payloads(payloads),
+    })
+    assert "error" in resp and "robust" in resp["error"]
+    assert len(srv.buffer) == 0                 # nothing poisoned the buffer
+
+
+# ===================================================== auto leaf pinning
+@pytest.mark.slow
+def test_auto_codec_map_pins_after_warmup(tmp_path):
+    """fed.dcn_compress='auto': the seeded warmup round measures each
+    leaf's topk-vs-countsketch error, pins a per-leaf map (scalars and
+    tiny leaves stay dense), records it in provenance, and holds it
+    fixed for the rest of the run."""
+    import sys
+
+    sys.path.insert(0, str((__import__("pathlib").Path(__file__).parent)))
+    from test_comms import _codec_trainer
+
+    t = _codec_trainer(
+        "auto", rounds=2,
+        **{"fed.dcn_auto_warmup": 1, "obs.dir": str(tmp_path / "obs")},
+    )
+    t.run()
+    chosen = t._auto_leaf_codecs
+    assert chosen is not None and len(chosen) > 0
+    assert set(chosen) <= {"none", "topk", "countsketch"}
+    # the map is recorded in provenance beside the obs artifacts
+    import json
+
+    with open(tmp_path / "obs" / "codec_map.json") as f:
+        recorded = json.load(f)
+    assert recorded["map"] and recorded["pinned_at_round"] >= 0
+    # same multiset of picks (the JSON is name-sorted, chosen is leaf-order)
+    assert sorted(recorded["map"].values()) == sorted(chosen)
+    # every tiny leaf (<= the dense floor) stays uncompressed
+    sizes = [
+        int(np.asarray(x).size)
+        for x in jax.tree_util.tree_leaves(
+            (t.state.user_params, t.state.news_params)
+        )
+    ]
+    # leaf order in the map matches the flattened (user, news) delta
+    per_client = [s // t.cfg.fed.num_clients for s in sizes]
+    for c, n in zip(chosen, per_client):
+        if n <= t._AUTO_DENSE_FLOOR:
+            assert c == "none"
+
+
+def test_sketch_rmse_helper_is_pooled():
+    a = {"x": np.zeros((3,), np.float32), "y": np.zeros((1,), np.float32)}
+    b = {"x": np.asarray([1.0, 0.0, 0.0], np.float32),
+         "y": np.asarray([2.0], np.float32)}
+    np.testing.assert_allclose(tree_rmse(a, b), np.sqrt(5.0 / 4.0))
+
+
+# ------------------------------------------------- config-contract guard
+
+
+def test_lint_schema_learned_sketch_knobs():
+    """The config-contract analyzer derives its schema from config.py's
+    dataclasses, so the auto-era knobs are auto-taught: a typo'd
+    `fed.dcn_auto_warmup`/`fed.dcn_sketch_*` read in source is a CC201
+    finding and `make check` fails."""
+    from pathlib import Path
+
+    from fedrec_tpu.analysis.config_contract import load_schema
+    from fedrec_tpu.analysis.core import Project
+
+    schema = load_schema(Project.load(Path(__file__).resolve().parents[1]))
+    assert schema is not None
+    fed = schema.section_keys.get("fed", set())
+    assert {"dcn_compress", "dcn_sketch_width", "dcn_sketch_seed",
+            "dcn_auto_warmup"} <= fed
+    # the typo'd spellings are NOT in the schema — reading them is CC201
+    assert "dcn_auto_warmpu" not in fed
+    assert "dcn_sketch_widht" not in fed
+
+
+def test_typoed_sketch_knob_fails_fast():
+    from fedrec_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig()
+    with pytest.raises(KeyError, match="fed.dcn_auto_warmpu"):
+        cfg.apply_overrides(["fed.dcn_auto_warmpu=2"])
+    cfg.apply_overrides(["fed.dcn_auto_warmup=2"])   # the real knob applies
+    assert cfg.fed.dcn_auto_warmup == 2
