@@ -266,6 +266,21 @@ def main() -> int:
     )
     codecs = codec_rows(host_trees, args.topk_ratio, args.sketch_width)
 
+    # the obs.wire trace-context envelope rides every async push request
+    # (ISSUE 18): measure its cost on a representative push frame and
+    # fail rather than bank an artifact where telemetry framing is a
+    # material fraction of the payload it accounts
+    from fedrec_tpu.obs.wire import envelope_overhead_bytes
+
+    push_req = {"cmd": "push", "worker": "0", "round": 0, "based_on": 0}
+    env_overhead = envelope_overhead_bytes(push_req)
+    env_pct = 100.0 * env_overhead / trainable
+    if env_pct >= 2.0:
+        raise SystemExit(
+            f"wire envelope overhead {env_overhead} B is {env_pct:.2f}% of "
+            f"the dense push payload ({trainable} B) — contract is < 2%"
+        )
+
     # steps per round at the reference's federated deployment scale:
     # MIND-small ~ 230k train impressions over 9 clients, batch 64
     steps = int(np.ceil(230_000 / 9 / cfg.data.batch_size))
@@ -291,6 +306,10 @@ def main() -> int:
         "codecs": codecs,
         "codec_topk_ratio": args.topk_ratio,
         "codec_sketch_width": args.sketch_width,
+        # measured obs.wire envelope framing cost per request vs the
+        # dense push payload (contract: < 2%, enforced above)
+        "wire_envelope_overhead_bytes": env_overhead,
+        "wire_envelope_overhead_pct_of_dense_push": round(env_pct, 6),
         "grad_avg_steps_per_round": steps,
         # both-direction / both-direction — like for like
         "reduction_vs_reference": {

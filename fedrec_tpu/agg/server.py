@@ -57,6 +57,8 @@ import numpy as np
 
 from fedrec_tpu.agg.buffer import AggBuffer, BufferEntry
 from fedrec_tpu.agg.commit import CommitPolicy, fold_commit
+from fedrec_tpu.obs import wire as wireobs
+from fedrec_tpu.obs.tracing import get_tracer
 from fedrec_tpu.comms import (
     SKETCH_PAYLOAD_KEY,
     codec_caps,
@@ -149,6 +151,10 @@ class AggServer:
         self._gate_ms: dict[str, float] = {}   # worker -> LAST commit gate
         self._push_bytes: dict[str, float] = {}  # worker -> wire bytes total
         self._push_counts: dict[str, int] = {}   # worker -> pushes total
+        self._push_flows: dict[str, int] = {}    # pending worker -> flow id
+        # the last commit's flow id + version: `global` replies attach it
+        # so the adopting worker's span can finish the commit's arrow
+        self._commit_flow: tuple[int, int] | None = None
         self._workers: set[str] = set()
         self._lock = threading.Lock()
         self._srv: socket.socket | None = None
@@ -194,6 +200,11 @@ class AggServer:
             "commit (the async analogue of critical-path gate_ms; a "
             "straggler that never closes a quorum stays ~0)",
             labels=("worker",),
+        )
+        self._g_fold = reg.gauge(
+            "agg.commit_fold_ms",
+            "server-side fold time of the last commit (the 'fold' share "
+            "of the queue/wire/fold commit-latency decomposition)",
         )
         self._m_push_bytes = reg.counter(
             "agg.push_bytes_total",
@@ -364,6 +375,12 @@ class AggServer:
             self.buffer.add(entry)
             self._workers.add(worker)
             self._arrival[worker] = entry.arrival_ms
+            # start a buffer->commit flow arrow inside this push's serve
+            # span; the commit that folds this contribution finishes it
+            if wireobs.current_envelope() is not None:
+                fid = wireobs.new_span_id()
+                get_tracer().flow("out", fid, worker=worker)
+                self._push_flows[worker] = fid
             committed = self._maybe_commit()
             self._g_pending.set(float(len(self.buffer)))
             self._persist()
@@ -414,11 +431,25 @@ class AggServer:
             return False
         entries = self.buffer.take_all()
         assert self.global_leaves is not None
-        self.global_leaves, stats = fold_commit(
-            self.global_leaves, entries, self.version, self.policy,
-            method=self.method, trim_k=self.trim_k,
-            clip_norm=self.clip_norm, sketch_seed=self.sketch_seed,
-        )
+        tracer = get_tracer()
+        commit_flow = wireobs.new_span_id()
+        fold_t0 = time.perf_counter()
+        with tracer.span("agg.commit", quorum=len(pending)):
+            # finish each folded push's buffer arrow inside the commit
+            # span, then start the commit's own arrow (the adopting
+            # workers' `global` spans finish it)
+            for w in {e.worker for e in entries}:
+                fid = self._push_flows.pop(w, None)
+                if fid is not None:
+                    tracer.flow("in", fid)
+            self.global_leaves, stats = fold_commit(
+                self.global_leaves, entries, self.version, self.policy,
+                method=self.method, trim_k=self.trim_k,
+                clip_norm=self.clip_norm, sketch_seed=self.sketch_seed,
+            )
+            tracer.flow("out", commit_flow, version=stats.version)
+        self._g_fold.set((time.perf_counter() - fold_t0) * 1e3)
+        self._commit_flow = (stats.version, commit_flow)
         self.version = stats.version
         # gate attribution: the quorum-closing arrival is charged its
         # marginal delay over the runner-up; everyone else 0
@@ -464,6 +495,13 @@ class AggServer:
             out: dict = {"version": self.version}
             if self.version > since:
                 out["payload"] = encode_leaves(self.global_leaves)
+                if (
+                    self._commit_flow is not None
+                    and self._commit_flow[0] == self.version
+                ):
+                    # rides the reply ENVELOPE (wire.last_reply_envelope
+                    # on the worker), so the response dict is unchanged
+                    wireobs.serve_extra(commit_flow=self._commit_flow[1])
             return out
 
     def status(self) -> dict:

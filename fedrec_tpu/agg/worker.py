@@ -67,6 +67,7 @@ def run_async_worker(
         payload_nbytes,
         validate_codec,
     )
+    from fedrec_tpu.obs import wire
     from fedrec_tpu.obs.fleet import request_json_line
 
     cfg = trainer.cfg
@@ -172,28 +173,39 @@ def run_async_worker(
                 flush=True,
             )
             time.sleep(straggle_s)
-        resp = rpc({
-            "cmd": "push", "worker": worker_id, "round": round_idx,
-            "epoch": epoch, "based_on": version, "weight": 1.0,
-            "payload": wire_payload, "codec": codec,
-        })
+        with trainer.tracer.span("agg.push", round=round_idx,
+                                 based_on=version):
+            resp = rpc({
+                "cmd": "push", "worker": worker_id, "round": round_idx,
+                "epoch": epoch, "based_on": version, "weight": 1.0,
+                "payload": wire_payload, "codec": codec,
+            })
         c_pushes.inc()
         g_staleness.set(float(max(0, int(resp["version"]) - version)))
 
         # bounded wait for a commit NEWER than our base; timing out is
         # the async contract (train on, push staler next round)
         deadline = time.monotonic() + global_wait_s
-        new_version, payload = version, None
+        new_version, payload, commit_flow = version, None, None
         while time.monotonic() < deadline:
             resp = rpc({"cmd": "global", "since": version})
             if "payload" in resp:
                 new_version, payload = int(resp["version"]), resp["payload"]
+                # the commit's flow id rides the reply ENVELOPE: finish
+                # the server's commit arrow inside our adoption span
+                reply_env = wire.last_reply_envelope()
+                if reply_env is not None:
+                    commit_flow = reply_env.get("commit_flow")
                 break
             time.sleep(poll_s)
         if payload is not None:
-            base = decode_leaves(payload)
-            version = new_version
-            _adopt(trainer, treedef, base)
+            with trainer.tracer.span("agg.adopt", version=new_version,
+                                     round=round_idx):
+                if commit_flow is not None:
+                    trainer.tracer.flow("in", int(commit_flow))
+                base = decode_leaves(payload)
+                version = new_version
+                _adopt(trainer, treedef, base)
             g_version.set(float(version))
         else:
             base = after

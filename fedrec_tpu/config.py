@@ -608,6 +608,26 @@ class FleetConfig:
 
 
 @dataclass
+class WireConfig:
+    """Wire-layer observability (``fedrec_tpu.obs.wire``).
+
+    Every TCP JSON-lines exchange (fleet pushes, membership control
+    plane, async agg pushes, serving requests) carries an ADDITIVE
+    trace-context envelope: causal flow arrows across processes in the
+    merged fleet trace, per-edge ``wire.*`` RTT/byte telemetry, and
+    NTP-style clock-offset estimation that aligns barrier-less (async)
+    incarnations.  ``enabled=false`` sends no envelope at all — wire
+    bytes are byte-identical to the pre-envelope protocol (pinned in
+    ``tests/test_wire.py``).  Spans follow the ``Tracer.enabled``
+    contract: default-on costs registry counters only when no
+    ``obs.dir`` will persist a trace.
+    """
+
+    enabled: bool = True               # false = byte-identical legacy wire
+    window: int = 32                   # per-edge offset median window
+
+
+@dataclass
 class ObsConfig:
     """Unified telemetry (fedrec_tpu.obs): registry snapshots + host spans.
 
@@ -631,6 +651,7 @@ class ObsConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     quality: QualityConfig = field(default_factory=QualityConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
+    wire: WireConfig = field(default_factory=WireConfig)
 
 
 @dataclass

@@ -42,6 +42,12 @@ Three pieces, one namespace:
   (``fedrec-obs fleet-trace``), per-round straggler/critical-path
   attribution (``fedrec-obs fleet``), and counter-baseline continuity
   across supervisor respawns.
+* :mod:`fedrec_tpu.obs.wire` — wire-layer observability: the additive
+  trace-context envelope every TCP JSON-lines exchange carries (causal
+  Perfetto flow arrows across processes), NTP-style per-edge
+  clock-offset estimation (the barrier-free alignment source async
+  incarnations resolve through), and per-edge ``wire.*`` RTT/byte/error
+  telemetry feeding the ``fedrec-obs fleet`` "Wire" panel.
 
 The package imports no JAX at module level — serving and CLI paths pull
 it in cheaply (health/device import jax lazily inside functions).
@@ -92,6 +98,12 @@ from fedrec_tpu.obs.device import (
     sample_device_memory,
     set_active_watchdog,
 )
+from fedrec_tpu.obs.wire import (
+    WIRE_KEY,
+    OffsetEstimator,
+    configure_wire,
+    wire_enabled,
+)
 from fedrec_tpu.obs.perf import (
     CostAnalysisRecorder,
     PerfMonitor,
@@ -112,14 +124,17 @@ __all__ = [
     "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "OffsetEstimator",
     "PerfMonitor",
     "QualityMonitor",
     "SlicedEvalAccumulator",
     "TelemetryCollector",
     "Tracer",
     "TrainingHealthError",
+    "WIRE_KEY",
     "build_report",
     "build_slice_defs",
+    "configure_wire",
     "dump_artifacts",
     "ensure_fleet_identity",
     "flops_per_train_step",
@@ -140,4 +155,5 @@ __all__ = [
     "set_fleet_identity",
     "set_registry",
     "set_tracer",
+    "wire_enabled",
 ]

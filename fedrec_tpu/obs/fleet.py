@@ -224,27 +224,58 @@ class TelemetryCollector:
 
 
 def request_json_line(
-    host: str, port: int, req: dict, timeout_s: float
+    host: str, port: int, req: dict, timeout_s: float, op: str | None = None
 ) -> dict:
     """THE client half of the one-shot JSON-lines exchange: connect,
     send one request line, read one response line.  Raises ``OSError``
     on transport failure (a hang-up with no response line included — an
     ack-less close is NOT a response) and ``ValueError`` on a malformed
-    or ``{"error": ...}`` reply.  Shared by :class:`FleetPusher` and
-    ``MembershipClient`` so the client wire protocol cannot drift."""
-    with socket.create_connection((host, port), timeout=timeout_s) as conn:
-        conn.sendall((json.dumps(req) + "\n").encode())
-        buf = b""
-        while b"\n" not in buf:
-            chunk = conn.recv(65536)
-            if not chunk:
-                break
-            buf += chunk
-    if not buf:
-        raise OSError("empty response (connection closed before a reply)")
-    resp = json.loads(buf.split(b"\n", 1)[0].decode())
-    if isinstance(resp, dict) and resp.get("error"):
-        raise ValueError(str(resp["error"]))
+    or ``{"error": ...}`` reply.  Shared by :class:`FleetPusher`,
+    ``MembershipClient`` and the async agg worker so the client wire
+    protocol cannot drift.
+
+    Wire observability (:mod:`fedrec_tpu.obs.wire`, default on): the
+    request carries an additive trace-context envelope, the reply's
+    envelope (if the peer echoes one) is stripped off before return and
+    feeds the per-edge RTT/offset telemetry — callers see the exact
+    pre-envelope response surface either way.  ``op`` labels the edge
+    (defaults to the request's ``cmd``)."""
+    from fedrec_tpu.obs import wire
+
+    req_env = None
+    if wire.wire_enabled():
+        op = op or str(req.get("cmd", "req"))
+        req_env = wire.request_envelope(op)
+        req = {**req, wire.WIRE_KEY: req_env}
+    line = (json.dumps(req) + "\n").encode()
+    t0 = time.perf_counter()
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s) as conn:
+            conn.sendall(line)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        if not buf:
+            raise OSError("empty response (connection closed before a reply)")
+        resp = json.loads(buf.split(b"\n", 1)[0].decode())
+        if isinstance(resp, dict) and resp.get("error"):
+            raise ValueError(str(resp["error"]))
+    except (OSError, ValueError):
+        if req_env is not None:
+            wire.record_client_error(host, port, str(op))
+        raise
+    ack_ts = time.time()
+    resp, resp_env = wire.unwrap_envelope(resp)
+    if req_env is not None:
+        wire._set_last_reply(resp_env)
+        wire.record_client_exchange(
+            host, port, str(op), req_env, resp_env,
+            bytes_sent=len(line), bytes_recvd=len(buf),
+            rtt_s=time.perf_counter() - t0, ack_ts=ack_ts,
+        )
     return resp
 
 
@@ -257,8 +288,18 @@ def serve_json_line(
     """THE one-request JSON-lines exchange: read one request line, answer
     ``handler(request)`` as one response line.  A torn or malformed
     connection answers ``{"error": "bad request"}`` where possible and
-    never raises — shared by :class:`CollectorServer` and the membership
-    service so the wire protocol cannot drift between the two servers."""
+    never raises — shared by :class:`CollectorServer`, the membership
+    service and the async commit authority so the wire protocol cannot
+    drift between servers.
+
+    Wire observability (:mod:`fedrec_tpu.obs.wire`): an incoming
+    trace-context envelope is stripped BEFORE ``handler`` sees the
+    request (unknown envelope keys never leak into op dispatch) and a
+    reply envelope is echoed ONLY when the request carried one — a
+    client that predates the envelope gets byte-identical pre-envelope
+    replies."""
+    from fedrec_tpu.obs import wire
+
     with conn:
         try:
             conn.settimeout(timeout_s)
@@ -268,9 +309,30 @@ def serve_json_line(
                 if not chunk:
                     return  # hung up before a full request line: no reply
                 buf += chunk
-            req = json.loads(buf.split(b"\n", 1)[0].decode())
-            resp = handler(req)
-            conn.sendall((json.dumps(resp) + "\n").encode())
+            req_line = buf.split(b"\n", 1)[0]
+            recv_ts = time.time()
+            req = json.loads(req_line.decode())
+            env = None
+            if isinstance(req, dict):
+                req, env = wire.unwrap_envelope(req)
+            if env is None:
+                resp = handler(req)
+                conn.sendall((json.dumps(resp) + "\n").encode())
+                return
+            token = wire.enter_serve(env, recv_ts)
+            try:
+                resp = handler(req)
+                reply_env = wire.server_reply_envelope(env, recv_ts)
+            finally:
+                wire.exit_serve(token)
+            if isinstance(resp, dict):
+                resp = {**resp, wire.WIRE_KEY: reply_env}
+            out = (json.dumps(resp) + "\n").encode()
+            conn.sendall(out)
+            wire.record_server_exchange(
+                env, reply_env, op=str(env.get("op") or "req"),
+                bytes_recvd=len(req_line) + 1, bytes_sent=len(out),
+            )
         except (OSError, ValueError, KeyError):
             try:
                 conn.sendall(b'{"error": "bad request"}\n')
@@ -638,20 +700,57 @@ def _fed_round_starts(trace: WorkerTrace) -> dict[int, float]:
     return out
 
 
+def wire_edge_offsets(
+    workers: dict[str, WorkerData],
+) -> dict[str, dict[str, float]]:
+    """Per-worker wire-measured clock offsets (seconds) toward each peer
+    it exchanged enveloped requests with: ``{worker: {peer: offset_s}}``
+    where ``offset_s`` is the PEER's clock minus the worker's — the
+    windowed NTP-style estimate :mod:`fedrec_tpu.obs.wire` publishes as
+    ``wire.clock_offset_ms{peer}``, read back from the last snapshot."""
+    from fedrec_tpu.obs.report import _metric_values
+
+    out: dict[str, dict[str, float]] = {}
+    for wid, w in workers.items():
+        snap = w.last_snapshot()
+        if snap is None:
+            continue
+        edges: dict[str, float] = {}
+        for row in _metric_values(snap, "wire.clock_offset_ms"):
+            peer = (row.get("labels") or {}).get("peer")
+            if peer is not None and "value" in row:
+                edges[str(peer)] = float(row["value"]) / 1e3
+        if edges:
+            out[wid] = edges
+    return out
+
+
 def estimate_clock_offsets(
     workers: dict[str, WorkerData],
 ) -> dict[tuple[str, int], float]:
     """Per-(worker, incarnation) clock correction in seconds, to ADD to
     that incarnation's wall clock.
 
-    Every worker's ``fed_round`` N begins at the same barrier collective
-    (the round-counter broadcast all members block on), so for each
-    incarnation the MEDIAN of (reference start - this start) over shared
-    rounds estimates its offset against the reference incarnation — the
-    one with the most ``fed_round`` spans (stable tie-break by worker
-    id).  Incarnations sharing no round with the reference (the
-    membership service; a worker that died pre-round) keep correction 0:
-    their ``epoch_unix`` wall anchor is the honest estimate."""
+    Two alignment sources, in precedence order:
+
+    1. **Round barrier** — every worker's ``fed_round`` N begins at the
+       same barrier collective (the round-counter broadcast all members
+       block on), so for each incarnation the MEDIAN of (reference
+       start - this start) over shared rounds estimates its offset
+       against the reference incarnation — the one with the most
+       ``fed_round`` spans (stable tie-break by worker id).  Barrier
+       alignment always wins where shared rounds exist.
+    2. **Wire edges** — an incarnation sharing NO round with the
+       reference (the async commit authority, the membership service, a
+       worker that died pre-round) resolves through the NTP-style
+       per-edge offsets :mod:`fedrec_tpu.obs.wire` measured
+       (:func:`wire_edge_offsets`): a worker that measured its offset to
+       an aligned hub adopts ``hub_correction + offset``, and a hub that
+       only ever ANSWERED requests is placed at the median of
+       ``client_correction - client_offset`` over its aligned clients.
+       The graph is walked to a fixpoint, so a chain of edges aligns
+       too.  Only incarnations the wire cannot reach keep correction 0
+       (the raw ``epoch_unix`` wall anchor, the honest fallback)."""
     rounds_by: dict[tuple[str, int], dict[int, float]] = {}
     for wid, w in workers.items():
         for i, tr in enumerate(w.traces):
@@ -661,14 +760,61 @@ def estimate_clock_offsets(
         if ref_key is None or len(rounds_by[key]) > len(rounds_by[ref_key]):
             ref_key = key
     offsets: dict[tuple[str, int], float] = {}
+    unaligned: set[tuple[str, int]] = set()
     ref_rounds = rounds_by.get(ref_key, {}) if ref_key is not None else {}
     for key, mine in rounds_by.items():
         shared = sorted(set(mine) & set(ref_rounds))
         if not shared or key == ref_key:
             offsets[key] = 0.0
+            if key != ref_key:
+                unaligned.add(key)
             continue
         deltas = sorted(ref_rounds[r] - mine[r] for r in shared)
         offsets[key] = deltas[len(deltas) // 2]  # median
+    if not unaligned:
+        return offsets
+    edges = wire_edge_offsets(workers)
+    if not edges:
+        return offsets
+    # worker-level corrections from barrier-aligned incarnations (the
+    # incarnation with the most fed_round spans speaks for the worker)
+    aligned: dict[str, float] = {}
+    spans_of: dict[str, int] = {}
+    for key, off in offsets.items():
+        if key in unaligned:
+            continue
+        wid, _ = key
+        n = len(rounds_by.get(key, {}))
+        if wid not in aligned or n >= spans_of[wid]:
+            aligned[wid] = off
+            spans_of[wid] = n
+    pending = {wid for wid, _ in unaligned if wid not in aligned}
+    for _ in range(len(pending) + 1):
+        placed: dict[str, float] = {}
+        for wid in sorted(pending):
+            cands = [
+                aligned[p] + o
+                for p, o in edges.get(wid, {}).items()
+                if p in aligned
+            ]
+            cands += [
+                aligned[c] - o
+                for c, ce in edges.items()
+                if c in aligned
+                for p, o in ce.items()
+                if p == wid
+            ]
+            if cands:
+                cands.sort()
+                placed[wid] = cands[len(cands) // 2]
+        if not placed:
+            break
+        aligned.update(placed)
+        pending -= set(placed)
+    for key in unaligned:
+        wid, _ = key
+        if wid in aligned:
+            offsets[key] = aligned[wid]
     return offsets
 
 
@@ -1034,6 +1180,7 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
             ("quorum_wait_ms", "agg.quorum_wait_ms"),
             ("gate_saved_ms", "agg.gate_saved_ms"),
             ("tier_reduce_ms", "agg.tier_reduce_ms"),
+            ("commit_fold_ms", "agg.commit_fold_ms"),
             ("buffer_pending", "agg.buffer_pending"),
             ("pushes", "agg.pushes_total"),
             ("global_version", "agg.global_version"),
@@ -1054,6 +1201,98 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
             agg[wid] = aw
     if agg:
         report["agg"] = agg
+
+    # ---- wire (obs.wire): per-edge request/RTT telemetry, the measured
+    # clock-offset table, and the queue/wire/fold decomposition of async
+    # commit latency. Silent when no worker published wire.* metrics.
+    wire_edges: dict[str, list[dict]] = {}
+    wire_offsets: dict[str, dict[str, float]] = {}
+    for wid in sorted(workers):
+        snap = workers[wid].last_snapshot()
+        if snap is None:
+            continue
+        edges: dict[tuple[str, str], dict[str, Any]] = {}
+
+        def _edge(lbl: dict) -> dict:
+            key = (str(lbl.get("peer", "?")), str(lbl.get("op", "?")))
+            return edges.setdefault(key, {"peer": key[0], "op": key[1]})
+
+        for name, fld in (
+            ("wire.requests_total", "requests"),
+            ("wire.errors_total", "errors"),
+            ("wire.reconnects_total", "reconnects"),
+            ("wire.bytes_sent_total", "bytes_sent"),
+            ("wire.bytes_recvd_total", "bytes_recvd"),
+        ):
+            for row in _metric_values(snap, name):
+                if "value" in row:
+                    _edge(row.get("labels") or {})[fld] = row["value"]
+        for name, fld in (
+            ("wire.rtt_ms", "rtt_ms"),
+            ("wire.server_ms", "server_ms"),
+        ):
+            for row in _metric_values(snap, name):
+                if row.get("count"):
+                    _edge(row.get("labels") or {})[fld] = round(
+                        row["sum"] / row["count"], 3
+                    )
+        if edges:
+            wire_edges[wid] = [edges[k] for k in sorted(edges)]
+        offs = {
+            str((row.get("labels") or {}).get("peer", "?")):
+                round(row["value"], 3)
+            for row in _metric_values(snap, "wire.clock_offset_ms")
+            if "value" in row
+        }
+        if offs:
+            wire_offsets[wid] = offs
+    if wire_edges or wire_offsets:
+        wire: dict[str, Any] = {}
+        if wire_edges:
+            wire["edges"] = wire_edges
+            slowest = None
+            for wid, rows in wire_edges.items():
+                for e in rows:
+                    if "rtt_ms" in e and (
+                        slowest is None or e["rtt_ms"] > slowest["rtt_ms"]
+                    ):
+                        slowest = {
+                            "worker": wid, "peer": e["peer"],
+                            "op": e["op"], "rtt_ms": e["rtt_ms"],
+                        }
+            if slowest:
+                wire["slowest_edge"] = slowest
+        if wire_offsets:
+            wire["offsets_ms"] = wire_offsets
+        # queue vs wire vs fold: the commit authority's quorum wait and
+        # fold time, plus each pushing worker's transport share (its
+        # push edge's RTT minus the echoed server handling time)
+        queue_ms = fold_ms = None
+        for aw in (report.get("agg") or {}).values():
+            if aw.get("role") == "agg_server":
+                queue_ms = aw.get("quorum_wait_ms")
+                fold_ms = aw.get("commit_fold_ms")
+        decomp_edges: dict[str, dict[str, Any]] = {}
+        for wid, rows in wire_edges.items():
+            for e in rows:
+                if e["op"] == "push" and "rtt_ms" in e:
+                    srv = e.get("server_ms", 0.0)
+                    decomp_edges[wid] = {
+                        "peer": e["peer"],
+                        "rtt_ms": e["rtt_ms"],
+                        "server_ms": srv,
+                        "wire_ms": round(max(e["rtt_ms"] - srv, 0.0), 3),
+                    }
+        if queue_ms is not None or fold_ms is not None or decomp_edges:
+            decomp: dict[str, Any] = {}
+            if queue_ms is not None:
+                decomp["queue_ms"] = queue_ms
+            if fold_ms is not None:
+                decomp["fold_ms"] = fold_ms
+            if decomp_edges:
+                decomp["edges"] = decomp_edges
+            wire["commit_decomposition"] = decomp
+        report["wire"] = wire
     return report
 
 
@@ -1218,6 +1457,62 @@ def render_fleet_text(report: dict) -> str:
                 before_s = "-" if before is None else f"{before:.1f}"
                 lines.append(
                     f"  worker {w}: {before_s} -> {gates[w]:.1f} ms"
+                )
+        lines.append("")
+    wire = report.get("wire")
+    if wire:
+        lines.append("## Wire")
+        edges = wire.get("edges")
+        if edges:
+            lines.append(
+                f"{'worker':<12} {'peer':<12} {'op':<10} {'reqs':>6} "
+                f"{'errs':>5} {'rtt_ms':>9} {'srv_ms':>9}"
+            )
+            for wid, rows in edges.items():
+                for e in rows:
+                    rtt = e.get("rtt_ms")
+                    srv = e.get("server_ms")
+                    lines.append(
+                        f"{wid:<12} {e['peer']:<12} {e['op']:<10} "
+                        f"{int(e.get('requests', 0)):>6} "
+                        f"{int(e.get('errors', 0)):>5} "
+                        f"{('-' if rtt is None else format(rtt, '.2f')):>9} "
+                        f"{('-' if srv is None else format(srv, '.2f')):>9}"
+                    )
+        offs = wire.get("offsets_ms")
+        if offs:
+            lines.append("")
+            lines.append("clock offsets (peer minus worker, ms):")
+            for wid, table in offs.items():
+                parts = ", ".join(
+                    f"{p}={v:+.1f}" for p, v in sorted(table.items())
+                )
+                lines.append(f"  worker {wid}: {parts}")
+        slow = wire.get("slowest_edge")
+        if slow:
+            lines.append("")
+            lines.append(
+                f"slowest edge: worker {slow['worker']} -> {slow['peer']} "
+                f"({slow['op']}) at {slow['rtt_ms']:.2f} ms mean RTT"
+            )
+        decomp = wire.get("commit_decomposition")
+        if decomp:
+            lines.append("")
+            lines.append("async commit latency (queue vs wire vs fold):")
+            head = []
+            if "queue_ms" in decomp:
+                head.append(
+                    f"queue(quorum wait)={decomp['queue_ms']:.1f}ms"
+                )
+            if "fold_ms" in decomp:
+                head.append(f"fold={decomp['fold_ms']:.2f}ms")
+            if head:
+                lines.append("  " + ", ".join(head))
+            for wid, d in (decomp.get("edges") or {}).items():
+                lines.append(
+                    f"  worker {wid} -> {d['peer']}: "
+                    f"wire={d['wire_ms']:.2f}ms "
+                    f"(rtt {d['rtt_ms']:.2f} - server {d['server_ms']:.2f})"
                 )
         lines.append("")
     if not report.get("workers"):

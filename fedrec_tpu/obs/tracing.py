@@ -140,6 +140,38 @@ class Tracer:
             **({"args": args} if args else {}),
         })
 
+    _FLOW_PH = {"out": "s", "step": "t", "in": "f"}
+
+    def flow(
+        self,
+        direction: str,
+        flow_id: int,
+        name: str = "wire",
+        ts: float | None = None,
+        **args: Any,
+    ) -> None:
+        """Record a Chrome-trace flow event (``ph`` s/t/f) — the arrow
+        primitive that links spans causally ACROSS processes in the
+        merged fleet trace.  ``direction`` is "out" (start), "step"
+        (intermediate) or "in" (finish); events sharing ``flow_id`` (and
+        the fixed "wire" category) form one arrow.  ``ts`` places the
+        event (tracer-clock seconds, default now) — it must fall inside
+        the span the arrow should bind to on this thread."""
+        ph = self._FLOW_PH[direction]
+        ev = {
+            "name": name,
+            "cat": "wire",
+            "ph": ph,
+            "id": int(flow_id),
+            "ts": self._us(self._clock() if ts is None else ts),
+            "pid": self._pid,
+            "tid": threading.get_ident() % 0x7FFFFFFF,
+            **({"args": args} if args else {}),
+        }
+        if ph == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice, not the next
+        self._append(ev)
+
     def instant(self, name: str, **args: Any) -> None:
         self._append({
             "name": name,

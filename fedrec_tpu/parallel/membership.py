@@ -538,7 +538,10 @@ class MembershipClient:
         timeout = timeout_s if timeout_s is not None else self.rpc_timeout_s
         # the shared client wire helper (obs.fleet also pushes telemetry
         # with it): transport failures surface as OSError, protocol /
-        # {"error": ...} replies as ValueError -> MembershipError
+        # {"error": ...} replies as ValueError -> MembershipError.  The
+        # helper also carries the obs.wire trace-context envelope, so
+        # every control-plane edge (join/heartbeat/leave) gets per-edge
+        # RTT + clock-offset telemetry for free
         from fedrec_tpu.obs.fleet import request_json_line
 
         try:

@@ -36,6 +36,8 @@ import json
 import random
 import time
 
+from fedrec_tpu.obs import wire
+
 
 class ServingUnavailable(ConnectionError):
     """Raised by :meth:`ServingClient.request_or_raise` when the retry
@@ -111,6 +113,8 @@ class ServingClient:
                 # a reconnect in the artifact's resilience accounting
                 if self._was_connected:
                     self.reconnects += 1
+                    if wire.wire_enabled():
+                        wire.record_reconnect(self.host, self.port)
                 self._was_connected = True
                 return True
             except (OSError, asyncio.TimeoutError):
@@ -131,16 +135,29 @@ class ServingClient:
         """
         budget_ms = deadline_ms if deadline_ms is not None else self.request_timeout_ms
         deadline = time.monotonic() + budget_ms / 1e3
-        line = (json.dumps(payload) + "\n").encode()
+        # wire envelope (obs.wire): additive trace context + per-edge
+        # RTT/offset telemetry; rebuilt per attempt so a retried request
+        # carries fresh send_ts.  Off -> byte-identical pre-envelope line.
+        op = str(payload.get("cmd", "score"))
         attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 self.failed_requests += 1
+                self._wire_error(op)
                 return {"error": "deadline"}
             if self._writer is None and not await self._connect(deadline):
                 self.failed_requests += 1
+                self._wire_error(op)
                 return {"error": "unavailable"}
+            req_env = (
+                wire.request_envelope(op) if wire.wire_enabled() else None
+            )
+            line = (json.dumps(
+                {**payload, wire.WIRE_KEY: req_env}
+                if req_env is not None else payload
+            ) + "\n").encode()
+            t0 = time.perf_counter()
             try:
                 self._writer.write(line)
                 await asyncio.wait_for(
@@ -153,6 +170,7 @@ class ServingClient:
                 # the stream is no longer line-synchronized; drop it
                 await self._drop()
                 self.failed_requests += 1
+                self._wire_error(op)
                 return {"error": "deadline"}
             except (ConnectionError, OSError):
                 # server went away mid-request (restart): reconnect and
@@ -162,6 +180,7 @@ class ServingClient:
                 attempt += 1
                 if time.monotonic() + delay >= deadline:
                     self.failed_requests += 1
+                    self._wire_error(op)
                     return {"error": "unavailable"}
                 await asyncio.sleep(delay)
                 continue
@@ -171,15 +190,30 @@ class ServingClient:
                 attempt += 1
                 if time.monotonic() + delay >= deadline:
                     self.failed_requests += 1
+                    self._wire_error(op)
                     return {"error": "unavailable"}
                 await asyncio.sleep(delay)
                 continue
             try:
-                return json.loads(raw)
+                resp = json.loads(raw)
             except json.JSONDecodeError:
                 await self._drop()
                 self.failed_requests += 1
+                self._wire_error(op)
                 return {"error": "bad_response"}
+            ack_ts = time.time()
+            resp, resp_env = wire.unwrap_envelope(resp)
+            if req_env is not None:
+                wire.record_client_exchange(
+                    self.host, self.port, op, req_env, resp_env,
+                    bytes_sent=len(line), bytes_recvd=len(raw),
+                    rtt_s=time.perf_counter() - t0, ack_ts=ack_ts,
+                )
+            return resp
+
+    def _wire_error(self, op: str) -> None:
+        if wire.wire_enabled():
+            wire.record_client_error(self.host, self.port, op)
 
     async def request_or_raise(
         self, payload: dict, deadline_ms: float | None = None

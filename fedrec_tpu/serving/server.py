@@ -43,6 +43,7 @@ from typing import Any
 import numpy as np
 
 from fedrec_tpu.obs import get_registry
+from fedrec_tpu.obs import wire
 from fedrec_tpu.serving.batcher import Backpressure, MicroBatcher
 from fedrec_tpu.serving.retrieval import build_index, build_two_stage_fn
 from fedrec_tpu.serving.store import EmbeddingStore, EmptyStoreError
@@ -333,18 +334,41 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
     service._conns.add(writer)
 
     async def one(raw: bytes) -> None:
+        # wire envelope (obs.wire): stripped BEFORE dispatch so unknown
+        # envelope keys never reach handle(); the reply echoes one ONLY
+        # when the request carried one (old clients see pre-envelope
+        # bytes).  contextvars make the serve ctx task-local here.
+        recv_ts = time.time()
+        env = reply_env = None
         try:
             req = json.loads(raw)
         except json.JSONDecodeError:
             resp: dict = {"error": "bad_json"}
         else:
-            resp = await service.handle(req)
+            req, env = wire.unwrap_envelope(req)
+            if env is None:
+                resp = await service.handle(req)
+            else:
+                token = wire.enter_serve(env, recv_ts)
+                try:
+                    resp = await service.handle(req)
+                    reply_env = wire.server_reply_envelope(env, recv_ts)
+                finally:
+                    wire.exit_serve(token)
+                if isinstance(resp, dict):
+                    resp = {**resp, wire.WIRE_KEY: reply_env}
+        out = (json.dumps(resp) + "\n").encode()
         async with write_lock:
-            writer.write((json.dumps(resp) + "\n").encode())
+            writer.write(out)
             try:
                 await writer.drain()
             except ConnectionError:
                 pass
+        if env is not None and reply_env is not None:
+            wire.record_server_exchange(
+                env, reply_env, op=str(env.get("op") or "score"),
+                bytes_recvd=len(raw), bytes_sent=len(out),
+            )
 
     while True:
         try:

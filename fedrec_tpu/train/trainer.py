@@ -841,6 +841,13 @@ class Trainer:
         ensure_fleet_identity(
             worker=str(jax.process_index()), rank=jax.process_index()
         )
+        # wire-layer observability (obs.wire): envelope on/off + offset
+        # window for every TCP exchange this process makes
+        from fedrec_tpu.obs.wire import configure_wire
+
+        configure_wire(
+            enabled=cfg.obs.wire.enabled, window=cfg.obs.wire.window
+        )
         self._m_rounds = self.registry.counter(
             "train.rounds_total", "federated rounds completed"
         )

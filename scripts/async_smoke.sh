@@ -18,7 +18,12 @@
 #        every round;
 #     4. FLEET: `fedrec-obs fleet` merges the commit authority's obs
 #        artifacts with the workers' and renders the Aggregation panel
-#        (commits / late folds / per-worker gate before-vs-after);
+#        (commits / late folds / per-worker gate before-vs-after) AND
+#        the Wire panel (per-edge RTT/offsets, the queue/wire/fold
+#        commit decomposition, the straggler's push edge on the table);
+#        `fedrec-obs fleet-trace` merges a trace whose wire flow arrows
+#        causally link a worker's push into the authority's commit and
+#        the commit into a worker's adoption — across process tracks;
 #     5. PERSIST: the pending buffer survives on disk (agg_buffer.npz in
 #        --state-dir) after the service stops;
 #     6. COUNTSKETCH: a second 2-worker cluster pushes
@@ -215,6 +220,81 @@ assert "## Aggregation" in text, "no Aggregation panel in the fleet text"
 assert "gate_ms before" in text, "no before/after gate panel"
 print("[async-smoke] fleet leg OK "
       f"(straggler gate {gates['3']:.0f} ms in the merged report)")
+PY
+
+# ------------------------------------------------- [4b] the wire leg:
+# the merged trace carries cross-process flow arrows (a worker's push
+# causally linked into the authority's commit, the commit linked into a
+# worker's adoption) and the fleet report carries the Wire panel with
+# the chaos-delayed worker's edge on it
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.cli.obs fleet-trace "$OUT/obs" \
+    -o "$OUT/fleet_trace.json"
+
+env -u PALLAS_AXON_POOL_IPS \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" \
+    python - <<'PY'
+import json
+import os
+from collections import defaultdict
+from pathlib import Path
+
+out = Path(os.environ["OUT"])
+doc = json.loads((out / "fleet_trace.json").read_text())
+events = doc["traceEvents"]
+pid_of = doc["otherData"]["workers"]          # wid -> pid
+agg_pid = pid_of["aggserver"]
+worker_pids = {p for w, p in pid_of.items() if w != "aggserver"}
+
+# cross-process flow arrows survived the merge
+flows = [e for e in events if e.get("cat") == "wire"]
+assert flows, "no wire flow events in the merged trace"
+by_id = defaultdict(list)
+for e in flows:
+    by_id[e["id"]].append(e)
+cross = {i for i, evs in by_id.items() if len({e["pid"] for e in evs}) >= 2}
+assert cross, "no flow id crosses two process tracks"
+
+# a worker push linked INTO the authority (start on a worker pid,
+# finish on the agg pid), and a commit linked OUT to an adopting worker
+push_arrows = [
+    i for i, evs in by_id.items()
+    if any(e["ph"] == "s" and e["pid"] in worker_pids for e in evs)
+    and any(e["ph"] == "f" and e["pid"] == agg_pid for e in evs)
+]
+adopt_arrows = [
+    i for i, evs in by_id.items()
+    if any(e["ph"] == "s" and e["pid"] == agg_pid for e in evs)
+    and any(e["ph"] == "f" and e["pid"] in worker_pids for e in evs)
+]
+assert push_arrows, "no flow arrow from a worker push into the authority"
+assert adopt_arrows, "no flow arrow from the authority out to an adoption"
+commits = [e for e in events
+           if e.get("name") == "agg.commit" and e.get("pid") == agg_pid]
+adopts = [e for e in events if e.get("name") == "agg.adopt"]
+assert commits, "no agg.commit spans on the authority's track"
+assert adopts, "no agg.adopt spans on any worker track"
+
+# the Wire panel made it into the fleet report, straggler edge included
+rep = json.loads((out / "fleet_report.json").read_text())
+wire = rep.get("wire") or {}
+edges = wire.get("edges") or {}
+w3 = edges.get("3") or []
+assert any(e.get("peer") == "aggserver" and e.get("op") == "push"
+           for e in w3), f"no worker-3 push edge in the Wire panel: {edges}"
+assert wire.get("offsets_ms"), "no per-edge clock offsets in the report"
+decomp = wire.get("commit_decomposition") or {}
+assert decomp.get("queue_ms") is not None, decomp
+assert decomp.get("edges"), decomp
+
+text = (out / "fleet_report.txt").read_text()
+assert "## Wire" in text, "no Wire panel in the fleet text"
+assert "slowest edge" in text, "no slowest-edge callout"
+print(f"[async-smoke] wire leg OK ({len(cross)} cross-process flow "
+      f"arrow(s), {len(push_arrows)} push->commit, "
+      f"{len(adopt_arrows)} commit->adopt)")
 PY
 
 # -------------------------------------------- [6] the countsketch leg:

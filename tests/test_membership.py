@@ -137,7 +137,9 @@ def test_min_world_blocks_formation():
         assert srv.status()["epoch"] == -1 and got[0] is None
         second = MembershipClient(srv.address, worker_id="8", join_timeout_s=15)
         asg2 = second.join()
-        t.join(10)
+        # outlast the joiner's own 15 s give-up: under a loaded 1-CPU
+        # suite run a 10 s wait expired while the join was still live
+        t.join(20)
         assert got[0] is not None and got[0].epoch == 0
         assert asg2.world == 2
     finally:

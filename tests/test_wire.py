@@ -1,0 +1,555 @@
+"""Wire-layer observability (``fedrec_tpu.obs.wire``): envelope
+round-trip and cross-version byte-compatibility pins, the NTP-style
+offset estimator on hand-made edges with KNOWN skew (and its
+asymmetric-latency bias bound), the wire alignment source in
+``fleet.estimate_clock_offsets`` (barrier precedence + barrier-less
+resolution), flow-event causality through the agg push->commit->adopt
+chain, and the fleet report's "Wire" panel."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.obs import wire
+from fedrec_tpu.obs.fleet import (
+    WorkerData,
+    WorkerTrace,
+    build_fleet_report,
+    build_fleet_trace,
+    estimate_clock_offsets,
+    render_fleet_text,
+    request_json_line,
+    reset_fleet_identity,
+    serve_json_line,
+    set_fleet_identity,
+    wire_edge_offsets,
+)
+from fedrec_tpu.obs.registry import MetricsRegistry, set_registry
+from fedrec_tpu.obs.tracing import Tracer, set_tracer
+
+
+@pytest.fixture()
+def fresh_obs():
+    """Fresh registry/tracer/identity/wire-state, restored afterwards."""
+    prev_reg = set_registry(MetricsRegistry())
+    prev_tr = set_tracer(Tracer())
+    reset_fleet_identity()
+    wire.reset_wire_state()
+    wire.configure_wire(enabled=True, window=32)
+    try:
+        yield
+    finally:
+        reset_fleet_identity()
+        wire.reset_wire_state()
+        wire.configure_wire(enabled=True, window=32)
+        set_registry(prev_reg)
+        set_tracer(prev_tr)
+
+
+def _serve_once(handler, n: int = 1, **kw):
+    """A one-shot JSON-lines server answering ``n`` connections through
+    serve_json_line; returns (port, done event).  The server records its
+    wire telemetry AFTER sending the reply, so a test reading
+    server-side spans/counters must wait on ``done`` — the client
+    returning only proves the reply bytes arrived."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    done = threading.Event()
+
+    def run():
+        try:
+            for _ in range(n):
+                conn, _ = srv.accept()
+                serve_json_line(conn, handler, **kw)
+            srv.close()
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port, done
+
+
+def _raw_exchange(port: int, line: bytes) -> bytes:
+    with socket.create_connection(("127.0.0.1", port), 5.0) as c:
+        c.sendall(line)
+        buf = b""
+        while b"\n" not in buf:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return buf
+
+
+# ------------------------------------------------------ envelope round trip
+def test_envelope_stripped_before_dispatch(fresh_obs):
+    seen = []
+
+    def handler(req):
+        seen.append(req)
+        return {"ok": True}
+
+    port, _ = _serve_once(handler)
+    resp = request_json_line("127.0.0.1", port, {"cmd": "ping", "x": 1}, 5.0)
+    assert resp == {"ok": True}  # reply envelope stripped client-side too
+    assert seen == [{"cmd": "ping", "x": 1}]  # no envelope key leaked
+
+
+def test_old_client_gets_byte_identical_reply(fresh_obs):
+    # a client that predates the envelope sends a bare line and must get
+    # the exact pre-envelope reply bytes (no _wire key echoed)
+    port, _ = _serve_once(lambda req: {"echo": req["x"]})
+    buf = _raw_exchange(port, b'{"cmd": "ping", "x": 7}\n')
+    assert buf == b'{"echo": 7}\n'
+
+
+def test_new_client_against_old_server(fresh_obs):
+    # an old server ignores unknown keys and echoes no envelope; the new
+    # client must round-trip fine and simply skip offset estimation
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def old_server():
+        conn, _ = srv.accept()
+        with conn:
+            buf = b""
+            while b"\n" not in buf:
+                buf += conn.recv(65536)
+            req = json.loads(buf.split(b"\n", 1)[0])
+            # old dispatch reads only the keys it knows
+            conn.sendall(
+                (json.dumps({"pong": req.get("x")}) + "\n").encode()
+            )
+        srv.close()
+
+    threading.Thread(target=old_server, daemon=True).start()
+    resp = request_json_line("127.0.0.1", port, {"cmd": "ping", "x": 3}, 5.0)
+    assert resp == {"pong": 3}
+    assert wire.last_reply_envelope() is None
+    from fedrec_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    assert "wire.requests_total" in snap["metrics"]
+    assert "wire.clock_offset_ms" not in snap["metrics"]  # no echo, no est
+
+
+def test_wire_disabled_sends_pre_envelope_bytes(fresh_obs):
+    wire.configure_wire(enabled=False)
+    lines = []
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def capture():
+        conn, _ = srv.accept()
+        with conn:
+            buf = b""
+            while b"\n" not in buf:
+                buf += conn.recv(65536)
+            lines.append(buf)
+            conn.sendall(b'{"ok": true}\n')
+        srv.close()
+
+    threading.Thread(target=capture, daemon=True).start()
+    resp = request_json_line("127.0.0.1", port, {"cmd": "ping"}, 5.0)
+    assert resp == {"ok": True}
+    assert lines == [(json.dumps({"cmd": "ping"}) + "\n").encode()]
+
+
+def test_reply_envelope_and_serve_extra(fresh_obs):
+    set_fleet_identity(worker="srv")
+
+    def handler(req):
+        wire.serve_extra(commit_flow=99)
+        return {"ok": True}
+
+    port, served = _serve_once(handler)
+    request_json_line("127.0.0.1", port, {"cmd": "ping"}, 5.0)
+    assert served.wait(5.0)
+    env = wire.last_reply_envelope()
+    assert env is not None
+    assert env["src"] == "srv"
+    assert env["commit_flow"] == 99
+    assert env["recv_ts"] <= env["reply_ts"]
+    # the peer label adopts the server's self-reported identity
+    from fedrec_tpu.obs import get_registry
+    from fedrec_tpu.obs.report import _metric_values
+
+    snap = get_registry().snapshot()
+    peers = {
+        row["labels"]["peer"]
+        for row in _metric_values(snap, "wire.rtt_ms")
+    }
+    assert peers == {"srv"}
+
+
+# ------------------------------------------------------- offset estimation
+def _exchange(est, skew, fwd, ret, proc=0.001, t=100.0):
+    """One exchange against a peer whose clock runs ``skew`` seconds
+    ahead, with forward/return latencies ``fwd``/``ret``."""
+    send = t
+    recv = t + fwd + skew
+    reply = recv + proc
+    ack = t + fwd + proc + ret
+    return est.add(send, recv, reply, ack)
+
+
+def test_offset_estimator_recovers_known_skew():
+    for skew in (5.0, -5.0):
+        est = wire.OffsetEstimator(window=8)
+        for i in range(8):
+            _exchange(est, skew, fwd=0.004, ret=0.004, t=100.0 + i)
+        assert est.offset() == pytest.approx(skew, abs=1e-9)
+
+
+def test_offset_estimator_asymmetry_bias_bound():
+    # the classic NTP bound: |estimate - true| <= |fwd - ret| / 2
+    skew, fwd, ret = 5.0, 0.030, 0.002
+    est = wire.OffsetEstimator(window=4)
+    for i in range(4):
+        _exchange(est, skew, fwd=fwd, ret=ret, t=10.0 + i)
+    assert abs(est.offset() - skew) <= abs(fwd - ret) / 2 + 1e-12
+
+
+def test_offset_estimator_median_rejects_outlier():
+    est = wire.OffsetEstimator(window=8)
+    for i in range(7):
+        _exchange(est, 5.0, fwd=0.004, ret=0.004, t=float(i))
+    # one queue-delayed return leg: instantaneous sample is badly biased
+    _exchange(est, 5.0, fwd=0.004, ret=2.0, t=99.0)
+    assert est.offset() == pytest.approx(5.0, abs=1e-9)
+
+
+def test_offset_recovered_within_100ms_under_jitter():
+    # the acceptance bound: +-5s injected skew, jittery asymmetric
+    # latencies up to 20ms -> windowed median within 100ms
+    rng = np.random.default_rng(0)
+    est = wire.OffsetEstimator(window=32)
+    for i in range(32):
+        _exchange(
+            est, 5.0,
+            fwd=float(rng.uniform(0.001, 0.020)),
+            ret=float(rng.uniform(0.001, 0.020)),
+            t=float(i),
+        )
+    assert abs(est.offset() - 5.0) < 0.100
+
+
+# ------------------------------------------------- fleet alignment source
+def _mk_round_trace(epoch_unix, rounds, skew_s=0.0):
+    events = []
+    for i, r in enumerate(rounds):
+        events.append({
+            "name": "fed_round", "ph": "X",
+            "ts": (i * 0.05 + skew_s) * 1e6, "dur": 0.01 * 1e6,
+            "pid": 1, "tid": 1, "args": {"step_num": r},
+        })
+    return WorkerTrace(epoch_unix=epoch_unix, events=events)
+
+
+def _offset_snapshot(edges_ms: dict[str, float]) -> dict:
+    return {
+        "metrics": {
+            "wire.clock_offset_ms": {
+                "kind": "gauge",
+                "values": [
+                    {"labels": {"peer": p}, "value": v}
+                    for p, v in edges_ms.items()
+                ],
+            }
+        }
+    }
+
+
+def test_barrier_alignment_wins_when_rounds_shared():
+    # both incarnations share fed_round spans; a contradictory wire
+    # offset row must NOT override the barrier median
+    workers = {
+        "0": WorkerData(
+            worker="0",
+            traces=[_mk_round_trace(1000.0, [0, 1, 2, 3])],
+            snapshots=[_offset_snapshot({"1": 9000.0})],
+        ),
+        "1": WorkerData(
+            worker="1",
+            traces=[_mk_round_trace(1000.0, [0, 1, 2, 3], skew_s=5.0)],
+        ),
+    }
+    offsets = estimate_clock_offsets(workers)
+    assert offsets[("1", 0)] == pytest.approx(-5.0)
+
+
+def test_wire_offsets_align_barrierless_incarnation():
+    # the async commit authority records no fed_round spans; worker 0's
+    # measured edge offset (+5s: aggserver clock ahead) must place it at
+    # correction -5s instead of the raw wall anchor (0)
+    agg_events = [{
+        "name": "agg.commit", "ph": "X", "ts": 0.0, "dur": 1e3,
+        "pid": 1, "tid": 1,
+    }]
+    workers = {
+        "0": WorkerData(
+            worker="0",
+            traces=[_mk_round_trace(1000.0, [0, 1, 2])],
+            snapshots=[_offset_snapshot({"aggserver": 5000.0})],
+        ),
+        "1": WorkerData(
+            worker="1",
+            traces=[_mk_round_trace(1000.0, [0, 1, 2])],
+        ),
+        "aggserver": WorkerData(
+            worker="aggserver",
+            traces=[WorkerTrace(epoch_unix=1000.0, events=agg_events)],
+        ),
+    }
+    assert wire_edge_offsets(workers) == {"0": {"aggserver": 5.0}}
+    offsets = estimate_clock_offsets(workers)
+    assert offsets[("0", 0)] == 0.0
+    assert offsets[("aggserver", 0)] == pytest.approx(-5.0)
+
+
+def test_wire_offsets_chain_to_fixpoint():
+    # svc is only reachable THROUGH aggserver (aggserver measured svc's
+    # clock 2s behind its own, so svc sits 3s ahead of the fleet):
+    # corr_svc = corr_agg - (-2) = -3
+    workers = {
+        "0": WorkerData(
+            worker="0",
+            traces=[_mk_round_trace(1000.0, [0, 1])],
+            snapshots=[_offset_snapshot({"aggserver": 5000.0})],
+        ),
+        "aggserver": WorkerData(
+            worker="aggserver",
+            traces=[WorkerTrace(epoch_unix=1000.0, events=[])],
+            snapshots=[_offset_snapshot({"svc": -2000.0})],
+        ),
+        "svc": WorkerData(
+            worker="svc",
+            traces=[WorkerTrace(epoch_unix=1000.0, events=[])],
+        ),
+    }
+    offsets = estimate_clock_offsets(workers)
+    assert offsets[("aggserver", 0)] == pytest.approx(-5.0)
+    assert offsets[("svc", 0)] == pytest.approx(-3.0)
+
+
+# ------------------------------------------------------- flow causality
+def test_flow_events_survive_fleet_merge(fresh_obs):
+    from fedrec_tpu.obs import get_tracer
+
+    set_fleet_identity(worker="srv")
+    port, served = _serve_once(lambda req: {"ok": True})
+    request_json_line("127.0.0.1", port, {"cmd": "push"}, 5.0)
+    # the server records its half AFTER replying: wait for the serve
+    # thread, or a loaded machine reads the events before the "f" lands
+    assert served.wait(5.0)
+    evs = get_tracer().events()
+    flows = [e for e in evs if e.get("cat") == "wire"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    (fid,) = {e["id"] for e in flows}
+    # split the one process's events into two synthetic workers (the
+    # client half and the server half) and merge: the flow pair must
+    # survive with its shared id on DIFFERENT pids
+    client_evs = [
+        e for e in evs
+        if e["name"] == "wire.request" or e.get("ph") == "s"
+    ]
+    server_evs = [
+        e for e in evs
+        if e["name"] == "wire.serve" or e.get("ph") == "f"
+    ]
+    workers = {
+        "w": WorkerData(
+            worker="w",
+            traces=[WorkerTrace(epoch_unix=1000.0, events=client_evs)],
+        ),
+        "srv": WorkerData(
+            worker="srv",
+            traces=[WorkerTrace(epoch_unix=1000.0, events=server_evs)],
+        ),
+    }
+    doc = build_fleet_trace(workers)
+    merged_flows = [
+        e for e in doc["traceEvents"] if e.get("cat") == "wire"
+    ]
+    assert {e["id"] for e in merged_flows} == {fid}
+    assert len({e["pid"] for e in merged_flows}) == 2
+
+
+def test_agg_push_commit_adopt_flow_chain(fresh_obs):
+    from fedrec_tpu.agg.server import AggServer, encode_leaves
+    from fedrec_tpu.obs import get_tracer
+
+    set_fleet_identity(worker="aggserver")
+    server = AggServer(world=2)
+    leaves = [np.zeros(4, np.float32)]
+
+    def enveloped(req):
+        env = wire.request_envelope(str(req["cmd"]))
+        token = wire.enter_serve(env, time.time())
+        try:
+            resp = server.handle(req)
+            reply = wire.server_reply_envelope(env, time.time())
+        finally:
+            wire.exit_serve(token)
+        return resp, reply
+
+    enveloped({"cmd": "init", "worker": "a", "payload": encode_leaves(leaves)})
+    for w in ("a", "b"):
+        resp, _ = enveloped({
+            "cmd": "push", "worker": w, "round": 0, "epoch": 0,
+            "based_on": 0, "weight": 1.0,
+            "payload": encode_leaves(leaves), "codec": "none",
+        })
+    assert resp["committed"] is True
+    resp, reply = enveloped({"cmd": "global", "since": -1})
+    assert resp["version"] == 1
+    # the commit's flow id rides the reply ENVELOPE, not the response
+    assert "commit_flow" in reply and "commit_flow" not in resp
+
+    evs = get_tracer().events()
+    assert any(e["name"] == "agg.commit" for e in evs)
+    flows = [e for e in evs if e.get("cat") == "wire"]
+    starts = {e["id"] for e in flows if e["ph"] == "s"}
+    finishes = {e["id"] for e in flows if e["ph"] == "f"}
+    # each push's buffer arrow finished inside the commit span, and the
+    # commit's own arrow started (its finish lands in the adopter)
+    assert reply["commit_flow"] in starts
+    assert len(starts & finishes) >= 2  # both pushes' arrows closed
+
+
+# ----------------------------------------------------------- report panel
+def _hist_row(peer, op, total_ms, count):
+    return {
+        "labels": {"peer": peer, "op": op},
+        "sum": total_ms, "count": count, "buckets": {"+Inf": count},
+    }
+
+
+def test_fleet_report_wire_panel():
+    snap0 = {
+        "metrics": {
+            "wire.requests_total": {
+                "kind": "counter",
+                "values": [
+                    {"labels": {"peer": "aggserver", "op": "push"},
+                     "value": 4.0},
+                ],
+            },
+            "wire.rtt_ms": {
+                "kind": "histogram",
+                "values": [_hist_row("aggserver", "push", 80.0, 4)],
+            },
+            "wire.server_ms": {
+                "kind": "histogram",
+                "values": [_hist_row("aggserver", "push", 8.0, 4)],
+            },
+            "wire.clock_offset_ms": {
+                "kind": "gauge",
+                "values": [
+                    {"labels": {"peer": "aggserver"}, "value": 41.5},
+                ],
+            },
+        }
+    }
+    snap3 = {
+        "metrics": {
+            "wire.requests_total": {
+                "kind": "counter",
+                "values": [
+                    {"labels": {"peer": "aggserver", "op": "push"},
+                     "value": 4.0},
+                ],
+            },
+            "wire.rtt_ms": {
+                "kind": "histogram",
+                "values": [_hist_row("aggserver", "push", 4000.0, 4)],
+            },
+        }
+    }
+    agg_snap = {
+        "metrics": {
+            "agg.commits_total": {
+                "kind": "counter", "values": [{"labels": {}, "value": 2.0}],
+            },
+            "agg.quorum_wait_ms": {
+                "kind": "gauge", "values": [{"labels": {}, "value": 120.0}],
+            },
+            "agg.commit_fold_ms": {
+                "kind": "gauge", "values": [{"labels": {}, "value": 3.5}],
+            },
+            "agg.worker_gate_ms": {
+                "kind": "gauge",
+                "values": [{"labels": {"worker": "0"}, "value": 10.0}],
+            },
+        }
+    }
+    workers = {
+        "0": WorkerData(worker="0", snapshots=[snap0]),
+        "3": WorkerData(worker="3", snapshots=[snap3]),
+        "aggserver": WorkerData(worker="aggserver", snapshots=[agg_snap]),
+    }
+    report = build_fleet_report(workers)
+    w = report["wire"]
+    assert w["edges"]["0"][0]["rtt_ms"] == pytest.approx(20.0)
+    assert w["offsets_ms"] == {"0": {"aggserver": 41.5}}
+    # the chaos-delayed worker's edge is the slowest-edge callout
+    assert w["slowest_edge"] == {
+        "worker": "3", "peer": "aggserver", "op": "push",
+        "rtt_ms": pytest.approx(1000.0),
+    }
+    decomp = w["commit_decomposition"]
+    assert decomp["queue_ms"] == 120.0
+    assert decomp["fold_ms"] == 3.5
+    assert decomp["edges"]["0"]["wire_ms"] == pytest.approx(18.0)
+
+    text = render_fleet_text(report)
+    assert "## Wire" in text
+    assert "slowest edge: worker 3 -> aggserver (push)" in text
+    assert "queue(quorum wait)=120.0ms" in text
+    assert "fold=3.50ms" in text
+
+
+# ------------------------------------------------------- serving client
+def test_serving_client_strips_echoed_envelope(fresh_obs):
+    # an "old" echo server bounces the request line back verbatim —
+    # including the unknown _wire key; the client must strip it
+    import asyncio
+
+    async def run():
+        async def echo(reader, writer):
+            line = await reader.readline()
+            writer.write(line)
+            await writer.drain()
+            writer.close()
+
+        srv = await asyncio.start_server(echo, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        from fedrec_tpu.serving.client import ServingClient
+
+        cli = ServingClient("127.0.0.1", port, request_timeout_ms=5000.0)
+        resp = await cli.request({"id": 1, "history": [2]})
+        await cli.close()
+        srv.close()
+        await srv.wait_closed()
+        return resp
+
+    resp = asyncio.run(run())
+    assert resp == {"id": 1, "history": [2]}
+    from fedrec_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    assert "wire.rtt_ms" in snap["metrics"]
+
+
+def test_envelope_overhead_is_bounded(fresh_obs):
+    set_fleet_identity(worker="w0")
+    req = {"cmd": "push", "worker": "w0", "payload": "x" * 100}
+    overhead = wire.envelope_overhead_bytes(req)
+    assert 0 < overhead < 200  # a handful of keys, not a payload
