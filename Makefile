@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate agg-scale async-smoke
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate agg-scale async-smoke watch-smoke
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -86,6 +86,14 @@ agg-scale:
 # it the full straggle)
 async-smoke:
 	@bash scripts/async_smoke.sh
+
+# continuous-watch smoke: a forced SLO breach (tight round-time objective
+# the JIT compile round blows through) must fire AND resolve through the
+# alert lifecycle, an unmeetable SLO must hold `fedrec-obs alerts`/`tail
+# --once` at exit 1, and the obs.slo-disabled path must leave zero watch
+# footprint (no alert records, no alert.* instruments)
+watch-smoke:
+	@bash scripts/watch_smoke.sh
 
 # communication-cost benchmark: measured per-codec wire buffers of the
 # flagship trees + the bytes-per-round x time-to-AUC tradeoff runs (CPU);

@@ -549,6 +549,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="shared sketch hash seed (fed.dcn_sketch_seed) "
                              "for decoding sketch-coded pushes — must match "
                              "every worker's")
+    parser.add_argument("--slo", default="",
+                        help="obs.slo.objectives spec evaluated at status "
+                             "cadence against this service's own registry "
+                             "(agg.quorum_wait_ms / agg.staleness / "
+                             "agg.buffer_pending ...); alert records land "
+                             "in --obs-dir's metrics.jsonl")
     args = parser.parse_args(argv)
     host, port = args.address.rsplit(":", 1)
     if args.obs_dir:
@@ -564,6 +570,23 @@ def main(argv: list[str] | None = None) -> int:
         sketch_seed=args.sketch_seed,
     ).start()
     print(f"[aggserver] serving on {server.address}", flush=True)
+    watch = None
+    if args.slo:
+        from pathlib import Path
+
+        from fedrec_tpu.config import SloConfig, WatchConfig
+        from fedrec_tpu.obs.watch import Watch
+
+        if args.obs_dir:
+            Path(args.obs_dir).mkdir(parents=True, exist_ok=True)
+        watch = Watch(
+            SloConfig(enabled=True, objectives=args.slo),
+            WatchConfig(),
+            jsonl_path=(
+                Path(args.obs_dir) / "metrics.jsonl"
+                if args.obs_dir else None
+            ),
+        )
 
     import signal
 
@@ -578,6 +601,8 @@ def main(argv: list[str] | None = None) -> int:
         last = None
         while True:
             time.sleep(2)
+            if watch is not None:
+                watch.evaluate()  # commit-cadence SLOs over agg.* gauges
             status = server.status() if args.obs_dir else None
             if args.obs_dir and status != last:
                 server.dump_obs()

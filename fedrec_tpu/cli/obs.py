@@ -63,6 +63,18 @@ Subcommands:
       ``fed_round`` N is a common event), membership epoch changes /
       lease expiries / joins / quarantines rendered as instants.
 
+  fedrec-obs alerts <dir | metrics.jsonl> [--json]
+      Alert timeline + active table off the ``{"kind":"alert"}``
+      lifecycle records (one obs dir, or every ``worker_*`` log under a
+      shared/collector dir — the fleet rules' ``worker_fleet`` included).
+      Exit 1 while any alert is still firing at the end of the log(s),
+      0 after everything resolved — scriptable as a gate.
+
+  fedrec-obs tail <dir | metrics.jsonl> [--once] [--interval S]
+      Live-follow the event log(s), printing each alert transition as it
+      lands (rotation-aware).  ``--once`` prints the transitions already
+      recorded and exits with the ``alerts`` exit-code contract.
+
 ``report``/``prom``/``fleet``/``fleet-trace`` import no JAX — usable on
 any box the artifacts were copied to; ``replay`` imports JAX lazily (and
 pins ``JAX_PLATFORMS=cpu`` unless the environment already chose a
@@ -443,6 +455,152 @@ def _cmd_fleet_trace(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------------ alerts
+def _alert_sources(path_arg: str) -> list[tuple[str | None, Path]]:
+    """-> [(worker_or_None, metrics_path)]: every ``worker_*`` log under
+    a shared/collector dir, or the single obs-dir / file log."""
+    p = Path(path_arg)
+    if p.is_dir():
+        wdirs = sorted(d for d in p.glob("worker_*") if d.is_dir())
+        if wdirs:
+            return [
+                (d.name[len("worker_"):], d / "metrics.jsonl")
+                for d in wdirs
+            ]
+        return [(None, p / "metrics.jsonl")]
+    return [(None, p)]
+
+
+def _load_alert_logs(path_arg: str):
+    """-> (timeline, active) across every source log, or an int exit
+    code.  Alert keys are scoped per source so two workers' ``slo:x``
+    lifecycles never collapse into one."""
+    from fedrec_tpu.obs.watch import active_alerts, alert_records
+
+    sources = _alert_sources(path_arg)
+    timeline: list[dict] = []
+    active: list[dict] = []
+    found_log = False
+    for worker, mp in sources:
+        if not mp.exists() and not Path(str(mp) + ".1").exists():
+            continue
+        try:
+            records, _ = load_jsonl(mp)
+        except OSError as e:
+            return _fail(f"cannot read {mp}: {e}")
+        found_log = True
+        recs = alert_records(records)
+        if worker is not None:
+            for r in recs:
+                r.setdefault("labels", {}).setdefault("worker", worker)
+        timeline.extend(recs)
+        active.extend(active_alerts(recs))
+    if not found_log:
+        return _fail(
+            f"no event log under {path_arg} (was the run started with "
+            "obs.dir / --obs-dir, and obs.slo.enabled to record alerts?)"
+        )
+    timeline.sort(key=lambda r: r.get("ts", 0.0))
+    return timeline, active
+
+
+def _format_alert_line(rec: dict) -> str:
+    import time as _time
+
+    ts = _time.strftime("%H:%M:%S", _time.localtime(rec.get("ts", 0.0)))
+    worker = (rec.get("labels") or {}).get("worker")
+    wtxt = f" worker={worker}" if worker is not None else ""
+    return (
+        f"{ts} {rec.get('event', '?').upper():<8} "
+        f"{rec.get('severity', '?'):<8} {rec.get('key', '?')}{wtxt}"
+        f"  {rec.get('summary', '')}"
+    )
+
+
+def _cmd_alerts(args) -> int:
+    loaded = _load_alert_logs(args.path)
+    if isinstance(loaded, int):
+        return loaded
+    timeline, active = loaded
+    if args.json:
+        print(json.dumps({"timeline": timeline, "active": active}, indent=2))
+        return 1 if active else 0
+    print("# Alert timeline")
+    if timeline:
+        for rec in timeline:
+            print(_format_alert_line(rec))
+    else:
+        print("(no alert transitions recorded)")
+    print()
+    print("# Active alerts")
+    if active:
+        for rec in sorted(active, key=lambda r: r.get("ts", 0.0)):
+            print(_format_alert_line(rec))
+    else:
+        print("(none — everything resolved)")
+    # the scriptable contract: firing -> 1, quiet -> 0 (errors exit 2)
+    return 1 if active else 0
+
+
+def _cmd_tail(args) -> int:
+    import time as _time
+
+    if args.once:
+        loaded = _load_alert_logs(args.path)
+        if isinstance(loaded, int):
+            return loaded
+        timeline, active = loaded
+        for rec in timeline:
+            print(_format_alert_line(rec))
+        return 1 if active else 0
+    sources = _alert_sources(args.path)
+    offsets: dict[Path, int] = {}
+    print(
+        f"fedrec-obs: following {len(sources)} log(s) under {args.path} "
+        "(ctrl-c to stop)",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            for worker, mp in sources:
+                try:
+                    size = mp.stat().st_size
+                except OSError:
+                    continue
+                pos = offsets.get(mp, 0)
+                if size < pos:
+                    pos = 0  # the log rotated under us: re-read from top
+                if size == pos:
+                    continue
+                try:
+                    with open(mp, "rb") as f:
+                        f.seek(pos)
+                        chunk = f.read()
+                except OSError:
+                    continue
+                # consume only COMPLETE lines; a partially-flushed tail
+                # stays unread until the writer finishes it
+                nl = chunk.rfind(b"\n")
+                if nl < 0:
+                    continue
+                offsets[mp] = pos + nl + 1
+                for line in chunk[: nl + 1].splitlines():
+                    try:
+                        rec = json.loads(line)
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        continue
+                    if rec.get("kind") != "alert":
+                        continue
+                    if worker is not None:
+                        rec.setdefault("labels", {}).setdefault(
+                            "worker", worker
+                        )
+                    print(_format_alert_line(rec), flush=True)
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 # ------------------------------------------------------------------ replay
 def _resolve_flightrec(path_arg: str) -> Path | None:
     """obs dir / flightrec dir / manifest.json path -> flightrec dir."""
@@ -706,6 +864,30 @@ def build_parser() -> argparse.ArgumentParser:
     ft.add_argument("-o", "--out", default=None,
                     help="output path (default <dir>/fleet_trace.json)")
     ft.set_defaults(fn=_cmd_fleet_trace)
+    al = sub.add_parser(
+        "alerts",
+        help="alert timeline + active table off the {\"kind\":\"alert\"} "
+             "records; exit 1 while any alert is still firing",
+    )
+    al.add_argument("path", help="obs dir, collector/shared dir, or "
+                                 "metrics.jsonl path")
+    al.add_argument("--json", action="store_true",
+                    help="machine-readable {timeline, active} instead of "
+                         "text (same exit-code contract)")
+    al.set_defaults(fn=_cmd_alerts)
+    tl = sub.add_parser(
+        "tail",
+        help="live-follow the event log(s), printing alert transitions "
+             "as they land",
+    )
+    tl.add_argument("path", help="obs dir, collector/shared dir, or "
+                                 "metrics.jsonl path")
+    tl.add_argument("--once", action="store_true",
+                    help="print the recorded transitions and exit with "
+                         "the alerts exit-code contract")
+    tl.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval seconds (default 1.0)")
+    tl.set_defaults(fn=_cmd_tail)
     return p
 
 

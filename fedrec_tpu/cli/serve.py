@@ -200,6 +200,18 @@ def main(argv: list[str] | None = None) -> int:
         _Path(args.obs_dir).mkdir(parents=True, exist_ok=True)
         jsonl = str(_Path(args.obs_dir) / "metrics.jsonl")
     logger = MetricLogger(jsonl_path=jsonl, jsonl_max_mb=cfg.obs.jsonl_max_mb)
+    if cfg.obs.slo.enabled:
+        # heartbeat-cadence watch: SLOs over the serve.* keys (p99, queue
+        # depth, staleness) evaluate in serve_forever's beat; the admin
+        # {"cmd":"alerts"} and fedrec-obs alerts read the same engine
+        from fedrec_tpu.obs.watch import Watch
+
+        service.watch = Watch(
+            cfg.obs.slo, cfg.obs.watch,
+            registry=service.registry,
+            jsonl_path=jsonl,
+            jsonl_max_mb=cfg.obs.jsonl_max_mb,
+        )
     try:
         asyncio.run(serve_forever(
             service, host=args.host, port=args.port,

@@ -628,6 +628,92 @@ class WireConfig:
 
 
 @dataclass
+class SloConfig:
+    """Declarative SLOs + multi-window burn-rate alerting
+    (``fedrec_tpu.obs.watch``).
+
+    ``objectives`` is a semicolon list of objectives over metrics the
+    registry already publishes::
+
+        round_time:train.round_seconds:p95<2.5;mfu:perf.mfu>=0.3;
+        serve_p99:serve.p99_ms<50;auc_all:eval.auc{slice=all}>0.6
+
+    Each objective is ``name:metric[{label=value,...}][:pQQ]OPthreshold``
+    with ``OP`` one of ``< <= > >=`` and an optional per-objective
+    error-budget target suffix ``@0.999`` (otherwise ``target``
+    applies).  Histogram metrics are read as the per-evaluation DELTA of
+    their bucket counts (the quantile of *this round's* observations,
+    not the lifetime distribution); counters as per-evaluation deltas;
+    gauges and record keys at face value.  Every evaluation scores one
+    good/bad event per objective, and the alert fires Google-SRE style:
+    when the burn rate (bad fraction / error budget) exceeds
+    ``fast_burn`` over the last ``fast_window`` evaluations AND
+    ``slow_burn`` over the last ``slow_window`` — windows are counted in
+    evaluations, so the thresholds scale with round cadence for the
+    Trainer, heartbeat cadence for ``fedrec-serve``, and commit cadence
+    for the async agg server.
+
+    Default OFF: with ``enabled=false`` no watch layer is constructed,
+    no ``alert.*`` instrument exists and the training program is
+    byte-identical to a pre-watch build (pinned in
+    ``tests/test_watch.py``).
+    """
+
+    enabled: bool = False
+    objectives: str = ""               # "" = burn-rate SLOs off (anomaly only)
+    target: float = 0.99               # default objective target (budget = 1-target)
+    fast_window: int = 12              # evaluations in the fast burn window
+    slow_window: int = 60              # evaluations in the slow burn window
+    fast_burn: float = 14.4            # burn-rate threshold over the fast window
+    slow_burn: float = 6.0             # burn-rate threshold over the slow window
+
+
+@dataclass
+class WatchConfig:
+    """Alert lifecycle + streaming anomaly detection knobs
+    (``fedrec_tpu.obs.watch``/``obs.alerts``; active only under
+    ``obs.slo.enabled``).
+
+    The anomaly detector keeps, per round-cadence series the
+    MetricLogger already emits, an EWMA baseline and a MAD
+    (median-absolute-deviation) scale over the trailing residual window;
+    a point whose robust z-score ``|x - ewma| / (1.4826 * MAD)`` exceeds
+    ``anomaly_z`` after ``anomaly_warmup`` observations raises an
+    anomaly alert — the net that catches regressions no explicit SLO
+    names.  The lifecycle engine drives every alert (SLO, anomaly, and
+    the unified health/quality/drift/perf triggers) through
+    pending→firing→resolved with dedup (a firing alert re-breaching
+    emits nothing new), flap suppression (``flap_max`` fire→resolve
+    cycles within ``flap_window`` evaluations mutes further transitions)
+    and severity.
+    """
+
+    anomaly: bool = True               # EWMA+MAD robust z-score detector on/off
+    anomaly_z: float = 6.0             # robust z-score firing threshold
+    anomaly_alpha: float = 0.3         # EWMA smoothing factor
+    anomaly_window: int = 32           # trailing residuals kept for the MAD scale
+    anomaly_warmup: int = 8            # observations before a series may fire
+    pending_for: int = 2               # consecutive breached evals before firing
+    resolve_after: int = 3             # consecutive healthy evals before resolve
+    flap_max: int = 3                  # fire cycles within flap_window -> suppress
+    flap_window: int = 20              # evaluations the flap counter looks back
+    history: int = 256                 # resolved alerts kept for surfaces
+    # serving drift-probe breach: a pre-swap probe whose top-k rank churn
+    # exceeds this fraction raises a serve:drift alert. 0 = off.
+    drift_churn_max: float = 0.5
+    # ---- fleet-level rules (collector/membership side):
+    # persistent straggler: a worker whose per-push mean round seconds
+    # exceeds factor x the fleet median for N consecutive pushes
+    fleet_straggler_factor: float = 2.0
+    fleet_straggler_evals: int = 3
+    # quorum-wait growth: last agg.quorum_wait_ms > factor x trailing median
+    fleet_quorum_factor: float = 3.0
+    # stalled commit version: a worker whose adopted agg version stops
+    # advancing for N pushes while its rounds keep completing
+    fleet_stalled_pushes: int = 3
+
+
+@dataclass
 class ObsConfig:
     """Unified telemetry (fedrec_tpu.obs): registry snapshots + host spans.
 
@@ -652,6 +738,8 @@ class ObsConfig:
     quality: QualityConfig = field(default_factory=QualityConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
     wire: WireConfig = field(default_factory=WireConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
+    watch: WatchConfig = field(default_factory=WatchConfig)
 
 
 @dataclass

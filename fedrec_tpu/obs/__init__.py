@@ -48,6 +48,15 @@ Three pieces, one namespace:
   clock-offset estimation (the barrier-free alignment source async
   incarnations resolve through), and per-edge ``wire.*`` RTT/byte/error
   telemetry feeding the ``fedrec-obs fleet`` "Wire" panel.
+* :mod:`fedrec_tpu.obs.watch` + :mod:`fedrec_tpu.obs.alerts` — the live
+  watch layer: declarative SLOs (``obs.slo.objectives``) with
+  Google-SRE multi-window burn-rate evaluation at round/heartbeat
+  cadence, an EWMA+MAD streaming anomaly detector over the round-cadence
+  series, one pending→firing→resolved alert lifecycle (dedup, flap
+  suppression) unifying the legacy health/quality/drift/perf triggers,
+  fleet-level rules at the collector (persistent straggler, world below
+  target, quorum-wait growth, stalled commit version), and the
+  ``fedrec-obs alerts``/``tail`` surfaces.
 
 The package imports no JAX at module level — serving and CLI paths pull
 it in cheaply (health/device import jax lazily inside functions).
@@ -111,14 +120,30 @@ from fedrec_tpu.obs.perf import (
     live_array_components,
     roofline_verdict,
 )
+from fedrec_tpu.obs.alerts import Alert, AlertEngine
+from fedrec_tpu.obs.watch import (
+    AnomalyDetector,
+    BurnRateEvaluator,
+    FleetRules,
+    SloObjective,
+    Watch,
+    active_alerts,
+    alert_records,
+    parse_slo_spec,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "Alert",
+    "AlertEngine",
+    "AnomalyDetector",
+    "BurnRateEvaluator",
     "CompileWatchdog",
     "CostAnalysisRecorder",
     "Counter",
     "DriftProbe",
     "FleetPusher",
+    "FleetRules",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
@@ -128,10 +153,14 @@ __all__ = [
     "PerfMonitor",
     "QualityMonitor",
     "SlicedEvalAccumulator",
+    "SloObjective",
     "TelemetryCollector",
     "Tracer",
     "TrainingHealthError",
     "WIRE_KEY",
+    "Watch",
+    "active_alerts",
+    "alert_records",
     "build_report",
     "build_slice_defs",
     "configure_wire",
@@ -144,6 +173,7 @@ __all__ = [
     "live_array_components",
     "load_jsonl",
     "load_trace",
+    "parse_slo_spec",
     "render_text",
     "roofline_verdict",
     "restore_counter_baseline",

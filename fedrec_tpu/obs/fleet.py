@@ -161,6 +161,10 @@ class TelemetryCollector:
         self._lock = threading.Lock()
         self.pushes = 0
         self.workers: dict[str, dict] = {}
+        # fleet-level watch rules (fedrec_tpu.obs.watch.FleetRules),
+        # evaluated per push when attached; their alert records land in
+        # worker_fleet/metrics.jsonl through the rules' own engine
+        self.rules = None
 
     def handle(self, req: dict) -> dict:
         cmd = req.get("cmd")
@@ -194,6 +198,12 @@ class TelemetryCollector:
                 "fleet": fleet,
                 "events": events,
             }))
+        # alert transition records ride the same envelope; written into
+        # the worker's log verbatim so fedrec-obs alerts/tail/fleet read
+        # them from a collector dir exactly as from an offline obs dir
+        for rec in req.get("alerts") or ():
+            if isinstance(rec, dict):
+                lines.append(json.dumps(rec))
         with self._lock:
             wdir = self.directory / f"worker_{wid}"
             wdir.mkdir(parents=True, exist_ok=True)
@@ -212,6 +222,11 @@ class TelemetryCollector:
             w["last_push"] = time.time()
             for k, v in fleet.items():
                 w[k] = v
+        if self.rules is not None:
+            try:
+                self.rules.observe_push(wid, snap)
+            except Exception:  # noqa: BLE001 — a rule bug must not
+                pass           # break telemetry ingestion
         return {"ok": True, "worker": wid}
 
     def status(self) -> dict:
@@ -430,6 +445,11 @@ class FleetPusher:
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
         self._sent_events = 0
+        # alert engine whose transition records ride the push envelope
+        # (set by the Trainer when the watch layer is live); the same
+        # disjoint-slice contract as trace events
+        self.engine = None
+        self._sent_alerts = 0
         self.failures = 0
         self._consec_failures = 0
         self._backoff_until = 0.0
@@ -457,6 +477,12 @@ class FleetPusher:
         worker = self.worker if self.worker is not None else ident.get("worker", "0")
         events = self.tracer.events()
         new = events[self._sent_events:]
+        alerts: list = []
+        next_alert_idx = self._sent_alerts
+        if self.engine is not None:
+            alerts, next_alert_idx = self.engine.records_since(
+                self._sent_alerts
+            )
         req = {
             "cmd": "telemetry_push",
             "worker": str(worker),
@@ -465,6 +491,7 @@ class FleetPusher:
             "epoch_unix": self.tracer.epoch_unix,
             "snapshot": self.registry.snapshot(),
             "events": new,
+            "alerts": alerts,
             "final": bool(final),
         }
         try:
@@ -483,6 +510,7 @@ class FleetPusher:
             return False
         # only advance past events the collector acknowledged
         self._sent_events += len(new)
+        self._sent_alerts = next_alert_idx
         self._consec_failures = 0
         self._backoff_until = 0.0
         self._m_pushes.inc()
@@ -1293,6 +1321,28 @@ def build_fleet_report(workers: dict[str, WorkerData]) -> dict:
                 decomp["edges"] = decomp_edges
             wire["commit_decomposition"] = decomp
         report["wire"] = wire
+
+    # ---- alerts (obs.watch): every worker's {"kind":"alert"} lifecycle
+    # records, the fleet rules' worker_fleet log included. The active set
+    # is computed PER worker, so two workers' identical keys (each runs
+    # its own slo:round_time) keep independent lifecycles.
+    from fedrec_tpu.obs.watch import active_alerts, alert_records
+
+    timeline: list[dict] = []
+    active: list[dict] = []
+    for wid in sorted(workers):
+        recs = alert_records(workers[wid].records)
+        for r in recs:
+            r.setdefault("labels", {}).setdefault("worker", wid)
+        timeline.extend(recs)
+        active.extend(active_alerts(recs))
+    if timeline:
+        timeline.sort(key=lambda r: r.get("ts", 0.0))
+        report["alerts"] = {
+            "transitions": len(timeline),
+            "active": active,
+            "recent": timeline[-12:],
+        }
     return report
 
 
@@ -1319,6 +1369,26 @@ def render_fleet_text(report: dict) -> str:
     ):
         lines.append("(* = membership service)")
     lines.append("")
+    al = report.get("alerts")
+    if al:
+        lines.append("## Alerts")
+        lines.append(f"transitions: {int(al.get('transitions', 0))}")
+        if al.get("active"):
+            lines.append(f"STILL FIRING ({len(al['active'])}):")
+            for r in al["active"]:
+                w = (r.get("labels") or {}).get("worker", "?")
+                lines.append(
+                    f"  [{r.get('severity', '?')}] worker {w} "
+                    f"{r.get('key', '?')}: {r.get('summary', '')}"
+                )
+        else:
+            lines.append("active: none (every fired alert resolved)")
+        for r in (al.get("recent") or [])[-6:]:
+            w = (r.get("labels") or {}).get("worker", "?")
+            lines.append(
+                f"  {r.get('event', '?'):<9} worker {w} {r.get('key', '?')}"
+            )
+        lines.append("")
     mem = report.get("membership")
     if mem:
         lines.append("## Membership")
@@ -1518,3 +1588,84 @@ def render_fleet_text(report: dict) -> str:
     if not report.get("workers"):
         lines.append("(no workers found)")
     return "\n".join(lines)
+
+
+# ------------------------------------------------------------- collector CLI
+def main(argv: list[str] | None = None) -> None:
+    """Standalone fleet telemetry collector: ``python -m
+    fedrec_tpu.obs.fleet HOST:PORT --dir D``.  With ``--watch`` the
+    fleet-level watch rules (:class:`fedrec_tpu.obs.watch.FleetRules`)
+    evaluate per push and their alert records land in
+    ``D/worker_fleet/metrics.jsonl`` — read by ``fedrec-obs alerts D``
+    like any other worker's log.  (The membership service offers the
+    same sink on its own port via ``--telemetry-dir``.)"""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="standalone fleet telemetry collector"
+    )
+    parser.add_argument("address", help="host:port to listen on")
+    parser.add_argument(
+        "--dir", required=True, help="collector artifact directory"
+    )
+    parser.add_argument(
+        "--watch", action="store_true",
+        help="evaluate fleet-level watch rules on every push "
+             "(straggler / quorum-wait growth / stalled commit)",
+    )
+    parser.add_argument(
+        "--target-world", type=int, default=0,
+        help="world size the fleet:world_below_target rule compares "
+             "against (0 disables the rule)",
+    )
+    parser.add_argument(
+        "--straggler-factor", type=float, default=None,
+        help="override obs.watch.fleet_straggler_factor for the "
+             "persistent-straggler rule",
+    )
+    parser.add_argument(
+        "--straggler-evals", type=int, default=None,
+        help="override obs.watch.fleet_straggler_evals (consecutive "
+             "breaching pushes before the straggler alert fires)",
+    )
+    parser.add_argument(
+        "--jsonl-max-mb", type=float, default=256.0,
+        help="per-worker log rotation bound",
+    )
+    args = parser.parse_args(argv)
+    host, _, port = args.address.rpartition(":")
+    collector = TelemetryCollector(args.dir, jsonl_max_mb=args.jsonl_max_mb)
+    if args.watch:
+        from fedrec_tpu.config import WatchConfig
+        from fedrec_tpu.obs.watch import FleetRules
+
+        wcfg = WatchConfig()
+        if args.straggler_factor is not None:
+            wcfg.fleet_straggler_factor = args.straggler_factor
+        if args.straggler_evals is not None:
+            wcfg.fleet_straggler_evals = args.straggler_evals
+        fleet_dir = Path(args.dir) / "worker_fleet"
+        fleet_dir.mkdir(parents=True, exist_ok=True)
+        collector.rules = FleetRules(
+            wcfg,
+            target_world=args.target_world,
+            jsonl_path=fleet_dir / "metrics.jsonl",
+        )
+    server = CollectorServer(collector, host or "127.0.0.1", int(port))
+    server.start()
+    print(
+        f"[collector] listening on {server.address} dir={args.dir}"
+        + (" watch=on" if args.watch else ""),
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(2.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

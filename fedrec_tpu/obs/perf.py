@@ -522,6 +522,11 @@ class PerfMonitor:
         self._drop_window = max(int(pcfg.capture_window), 2)
         self._pending_trigger = False
         self._triggered = 0
+        # when the watch layer is live (obs.slo.enabled) the drop trigger
+        # routes through the alert engine instead of arming directly:
+        # Watch.bind_perf sets the hook and arms via arm_capture() off the
+        # alert's firing transition (one lifecycle, no private flag)
+        self.watch_hook = None
         self._active: dict | None = None
         self.last_round: dict | None = None
 
@@ -604,11 +609,23 @@ class PerfMonitor:
                 if len(trailing) >= 2:
                     mean = sum(trailing) / len(trailing)
                     if mean > 0 and rate < (1.0 - self._drop) * mean:
-                        self._pending_trigger = True
+                        if self.watch_hook is not None:
+                            self.watch_hook(round_idx, rate, mean)
+                        else:
+                            self._pending_trigger = True
             self._rates.append(rate)
         return out
 
     # ------------------------------------------------------------ capture
+    def arm_capture(self) -> bool:
+        """Arm a triggered capture of the next round (the watch layer's
+        entry point: called when the efficiency-drop alert fires).
+        Returns False once the triggered-capture budget is spent."""
+        if self._triggered >= self.MAX_TRIGGERED_CAPTURES:
+            return False
+        self._pending_trigger = True
+        return True
+
     def capture_before_round(
         self, round_idx: int, num_rounds: int = 1
     ) -> str | None:

@@ -684,6 +684,23 @@ def build_report(
         if overflow is not None:
             report["cap_overflow_steps"] = overflow
 
+    # ---- alert lifecycle (the watch layer's {"kind":"alert"} records;
+    # silent on a run with obs.slo.enabled=false — no records, no panel)
+    from fedrec_tpu.obs.watch import active_alerts, alert_records
+
+    alerts = alert_records(records)
+    if alerts:
+        by_event: dict[str, int] = {}
+        for r in alerts:
+            ev = str(r.get("event", "?"))
+            by_event[ev] = by_event.get(ev, 0) + 1
+        report["alerts"] = {
+            "transitions": len(alerts),
+            "by_event": by_event,
+            "active": active_alerts(alerts),
+            "recent": alerts[-8:],
+        }
+
     # ---- span summary
     if trace_events:
         report["spans"] = dict(sorted(span_summary(trace_events).items()))
@@ -761,6 +778,23 @@ def render_text(report: dict) -> str:
                 f"(recompiles: {int(hl.get('xla_recompiles', 0))}, "
                 f"storms: {int(hl.get('recompile_storms', 0))})"
             )
+        lines.append("")
+    al = report.get("alerts")
+    if al:
+        lines.append("## Alerts")
+        by = ", ".join(
+            f"{k}={v}" for k, v in sorted(al["by_event"].items())
+        )
+        lines.append(f"transitions: {al['transitions']} ({by})")
+        if al["active"]:
+            lines.append(f"STILL FIRING ({len(al['active'])}):")
+            for r in al["active"]:
+                lines.append(
+                    f"  [{r.get('severity', '?')}] {r.get('key', '?')}: "
+                    f"{r.get('summary', '')}"
+                )
+        else:
+            lines.append("active: none (every fired alert resolved)")
         lines.append("")
     rb = report.get("robustness")
     if rb:

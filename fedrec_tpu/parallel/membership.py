@@ -735,6 +735,14 @@ def main(argv: list[str] | None = None) -> int:
                              "(fedrec_tpu.obs.fleet) on THIS port: "
                              "workers' obs.fleet.collector pushes land "
                              "as worker_* dirs under this directory")
+    parser.add_argument("--watch", action="store_true",
+                        help="evaluate the fleet-level watch rules "
+                             "(fedrec_tpu.obs.watch.FleetRules: persistent "
+                             "straggler, world below target, quorum-wait "
+                             "growth, stalled commit) against incoming "
+                             "telemetry pushes and the membership world; "
+                             "alert records land under the telemetry dir's "
+                             "worker_fleet/ (needs --telemetry-dir)")
     args = parser.parse_args(argv)
     host, port = args.address.rsplit(":", 1)
     collector = None
@@ -742,6 +750,19 @@ def main(argv: list[str] | None = None) -> int:
         from fedrec_tpu.obs.fleet import TelemetryCollector
 
         collector = TelemetryCollector(args.telemetry_dir)
+    rules = None
+    if args.watch and collector is not None:
+        from pathlib import Path
+
+        from fedrec_tpu.obs.watch import FleetRules
+
+        fleet_dir = Path(args.telemetry_dir) / "worker_fleet"
+        fleet_dir.mkdir(parents=True, exist_ok=True)
+        rules = FleetRules(
+            target_world=args.target_world,
+            jsonl_path=fleet_dir / "metrics.jsonl",
+        )
+        collector.rules = rules
     if args.obs_dir:
         from fedrec_tpu.obs.fleet import set_fleet_identity
 
@@ -773,7 +794,14 @@ def main(argv: list[str] | None = None) -> int:
         last_status = None
         while True:
             time.sleep(5)
-            status = server.status() if args.obs_dir else None
+            status = (
+                server.status() if (args.obs_dir or rules is not None)
+                else None
+            )
+            if rules is not None and status is not None:
+                # the world-below-target rule only the membership service
+                # can evaluate: it owns the authoritative world count
+                rules.observe_world(status["world"])
             if args.obs_dir and status != last_status:
                 server.dump_obs()
                 last_status = status

@@ -70,9 +70,15 @@ class ServingService:
         id_map: dict[int, str] | None = None,
         latency_window: int = 8192,
         registry=None,
+        watch=None,
     ):
         self.model = model
         self.store = store
+        # in-process watch layer (fedrec_tpu.obs.watch.Watch, built by the
+        # CLI when obs.slo.enabled): evaluated at heartbeat cadence in
+        # serve_forever, fed drift-probe results on refresh, surfaced via
+        # the admin {"cmd": "alerts"}. None = exact pre-watch behavior.
+        self.watch = watch
         self.top_k = int(top_k)
         self.exclude_history = exclude_history
         self.num_clusters = int(num_clusters)
@@ -244,6 +250,14 @@ class ServingService:
         cmd = req.get("cmd")
         if cmd == "metrics":
             return {"metrics": self.metrics()}
+        if cmd == "alerts":
+            # active + recent alerts from the in-process watch; an
+            # un-watched server answers the empty shape, not an error —
+            # the command is part of the admin contract either way
+            # (strict-superset pin in tests/test_watch.py)
+            if self.watch is not None:
+                return {"alerts": self.watch.engine.snapshot_state()}
+            return {"alerts": {"active": [], "recent": []}}
         if cmd == "prometheus":
             # text exposition over the admin protocol: a scraper sidecar
             # (or curl | promtool) gets the full registry, not just the
@@ -267,6 +281,10 @@ class ServingService:
                 round=round_, source=source,
             )
             self._cache_fn(gen.generation, fn)
+            if self.watch is not None:
+                # unified trigger path: a drift-probe breach on this swap
+                # pulses the serve:drift alert (scored at the next beat)
+                self.watch.ingest_drift(self.store.metrics())
             return {"refreshed": True, "generation": gen.generation,
                     "round": gen.round, "source": gen.source}
         return {"error": f"unknown_cmd: {cmd}"}
@@ -455,6 +473,15 @@ async def serve_forever(
             step += 1
             if logger is not None:
                 service.log_metrics(logger, step)
+            if service.watch is not None:
+                # heartbeat-cadence watch tick, fed the serve.* metric
+                # snapshot so SLOs over serve.p99_ms etc. read fresh
+                # values without waiting on a registry collector pass
+                service.watch.evaluate(record={
+                    f"serve.{k}": v for k, v in service.metrics().items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                })
             if obs_dir is not None:
                 # periodic registry snapshots make the event log useful
                 # even when the server is killed rather than signalled;

@@ -1010,6 +1010,14 @@ class Trainer:
             "agg.staleness",
             "mean staleness (commits behind) of the last commit's folds",
         )
+        self._g_agg_version = self.registry.gauge(
+            "agg.adopted_version",
+            "global model version this worker last adopted (async commit "
+            "counter; 0 until a first commit) — the fleet stalled-commit "
+            "rule watches it against train.rounds_total",
+        )
+        # a restored agg-buffer sidecar already adopted a version above
+        self._g_agg_version.set(float(self._agg_version))
         self._g_agg_quorum_wait = self.registry.gauge(
             "agg.quorum_wait_ms",
             "first-report -> quorum-close time of the last async commit "
@@ -1189,6 +1197,29 @@ class Trainer:
                 obs_dir=self._obs_dir,
             )
             self._perf_keep_batch = cfg.obs.perf.hbm_components
+        # ---- continuous watch layer (fedrec_tpu.obs.watch): declarative
+        # SLO burn rates + the streaming anomaly detector + the unified
+        # alert lifecycle, evaluated once per round in _after_round with
+        # the round's MetricLogger record. Default OFF — nothing below is
+        # constructed, no alert.* instrument registers, and the legacy
+        # trigger paths keep their exact pre-watch behavior (the
+        # byte-identity pin in tests/test_watch.py).
+        self.watch = None
+        if cfg.obs.slo.enabled:
+            from fedrec_tpu.obs.watch import Watch
+
+            self.watch = Watch(
+                cfg.obs.slo, cfg.obs.watch,
+                registry=self.registry, tracer=self.tracer,
+                jsonl_path=jsonl_path,
+                jsonl_max_mb=cfg.obs.jsonl_max_mb,
+            )
+            if self.perf is not None:
+                self.watch.bind_perf(self.perf)
+            if self.fleet_pusher is not None:
+                # alert transition records ride the existing telemetry
+                # envelope so the collector sees every worker's alerts
+                self.fleet_pusher.engine = self.watch.engine
         self.watchdog = CompileWatchdog(
             registry=self.registry,
             storm_threshold=hcfg.storm_threshold,
@@ -1781,6 +1812,11 @@ class Trainer:
             start_round, arrays, list(round_losses),
             ignore_clients=set(self._quarantine),
         )
+        if self.watch is not None:
+            # unified trigger path: the health monitor's verdicts pulse
+            # through the alert engine (scored at the round's evaluate)
+            self.watch.ingest_health_trigger(trigger)
+            self.watch.ingest_health_outliers(self.health.last_outliers)
         # ---- quarantine-and-rollback (fed.robust.recover): a non-finite
         # update or an outlier client becomes a RECOVERABLE trigger while
         # retries remain — run() quarantines the client, restores the
@@ -2882,6 +2918,7 @@ class Trainer:
             sketch_seed=cfg.fed.dcn_sketch_seed,
         )
         self._agg_version = stats.version
+        self._g_agg_version.set(float(stats.version))
         for e in late_entries:
             self.agg_buffer.add(e)
 
@@ -3367,6 +3404,8 @@ class Trainer:
             # surfaced on the HealthMonitor next to the norm-based flags
             # (one triage surface); informational — never a trigger
             self.health.last_quality_outliers = outliers
+            if self.watch is not None:
+                self.watch.ingest_quality_outliers(outliers)
 
     # ------------------------------------------------------------------
     def run(self) -> list[RoundResult]:
@@ -3656,5 +3695,9 @@ class Trainer:
             # snapshots are the event log's bulk on long runs
             rotate_jsonl(self._obs_dir / "metrics.jsonl", cfg.obs.jsonl_max_mb)
             self.registry.write_snapshot(self._obs_dir / "metrics.jsonl")
+        if self.watch is not None:
+            # one watch tick per round, fed the round's log record, BEFORE
+            # the fleet push so this round's transitions ride this push
+            self.watch.evaluate(record=log)
         if self.fleet_pusher is not None:
             self.fleet_pusher.maybe_push(round_idx)

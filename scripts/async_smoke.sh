@@ -26,7 +26,13 @@
 #        the commit into a worker's adoption — across process tracks;
 #     5. PERSIST: the pending buffer survives on disk (agg_buffer.npz in
 #        --state-dir) after the service stops;
-#     6. COUNTSKETCH: a second 2-worker cluster pushes
+#     6. FLEET WATCH: a live telemetry collector (--watch) receives
+#        every worker's round pushes; its fleet-level watch rules must
+#        catch worker 3 as a persistent straggler from push inter-arrival
+#        gaps alone (the chaos sleep sits at the push boundary, OUTSIDE
+#        train.round_seconds) and write a firing fleet:straggler:3 alert
+#        record to the collector's worker_fleet log;
+#     7. COUNTSKETCH: a second 2-worker cluster pushes
 #        fed.dcn_compress=countsketch — the commit authority folds the
 #        raw sketches in sketch space (version still advances one per
 #        round) and the measured per-push wire bytes land well under the
@@ -48,6 +54,11 @@ import socket
 s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
 PY
 )
+CPORT=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
 
 ROUNDS=3
 STRAGGLE_MS=4000
@@ -61,7 +72,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     --state-dir "$OUT/aggstate" \
     > "$OUT/aggserver.log" 2>&1 &
 AGG_PID=$!
-cleanup() { kill "$AGG_PID" 2>/dev/null || true; }
+
+# --------------------------- the live telemetry collector (fleet watch):
+# --straggler-evals 2 because 3 rounds give worker 3 only 2 push gaps —
+# both breach (4s sleep vs the trio's sub-second cadence), so the rule
+# confirms and fires on the last push. JAX is never imported here.
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m fedrec_tpu.obs.fleet "127.0.0.1:$CPORT" \
+    --dir "$OUT/collector" --watch --straggler-evals 2 \
+    > "$OUT/collector.log" 2>&1 &
+COLL_PID=$!
+cleanup() { kill "$AGG_PID" "$COLL_PID" 2>/dev/null || true; }
 trap cleanup EXIT
 sleep 1
 
@@ -87,6 +108,7 @@ run_worker() {
         --set "train.eval_every=$ROUNDS" \
         --set optim.user_lr=0.001 --set optim.news_lr=0.001 \
         --set "obs.dir=$OUT/obs" \
+        --set "obs.fleet.collector=127.0.0.1:$CPORT" \
         "${extra[@]}" \
         > "$OUT/worker_$1.log" 2>&1
 }
@@ -165,6 +187,27 @@ PY
 # straggler really straggled (the chaos knob engaged)
 grep -q "straggling" "$OUT/worker_3.log" \
     || { echo "[async-smoke] worker 3 never straggled"; exit 1; }
+
+# ---------------------------------------- [6] fleet watch at the collector:
+# the persistent-straggler rule must have caught worker 3 from its push
+# cadence alone and written a firing alert record to the fleet log
+FLEET_LOG="$OUT/collector/worker_fleet/metrics.jsonl"
+test -s "$FLEET_LOG" \
+    || { echo "[async-smoke] collector wrote no fleet watch log"; \
+         tail -20 "$OUT/collector.log"; exit 1; }
+grep '"kind": "alert"' "$FLEET_LOG" | grep '"key": "fleet:straggler:3"' \
+    | grep -q '"event": "firing"' \
+    || { echo "[async-smoke] fleet rule never fired on the straggler"; \
+         cat "$FLEET_LOG"; exit 1; }
+# ...and stayed quiet about the on-time trio
+if grep '"event": "firing"' "$FLEET_LOG" \
+    | grep -qE '"key": "fleet:straggler:[012]"'; then
+    echo "[async-smoke] fleet rule flagged an on-time worker"; exit 1
+fi
+echo "[async-smoke] fleet watch caught the straggler:"
+grep '"key": "fleet:straggler:3"' "$FLEET_LOG" | head -1
+kill -TERM "$COLL_PID" 2>/dev/null || true
+wait "$COLL_PID" 2>/dev/null || true
 
 # ------------------------------------------------ stop the service (flushes
 # its obs artifacts + the buffer sidecar on the way down)
@@ -297,7 +340,7 @@ print(f"[async-smoke] wire leg OK ({len(cross)} cross-process flow "
       f"{len(adopt_arrows)} commit->adopt)")
 PY
 
-# -------------------------------------------- [6] the countsketch leg:
+# -------------------------------------------- [7] the countsketch leg:
 # a fresh 2-worker cluster pushing sketch-coded deltas — commits advance
 # and the wire bytes shrink ~1/sketch_width vs the dense leg
 SPORT=$(python - <<'PY'
@@ -313,7 +356,7 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     --state-dir "$OUT/aggstate_sk" \
     > "$OUT/aggserver_sk.log" 2>&1 &
 SK_PID=$!
-cleanup() { kill "$AGG_PID" "$SK_PID" 2>/dev/null || true; }
+cleanup() { kill "$AGG_PID" "$COLL_PID" "$SK_PID" 2>/dev/null || true; }
 sleep 1
 
 run_sketch_worker() {

@@ -22,7 +22,11 @@
 #      `fedrec-obs perf` exit 0, the capture-window trace landing inside
 #      obs.dir with its metrics.jsonl pointer record, then the
 #      perf-regression gate: bank a fresh baseline, pass a clean check,
-#      and prove --demo-regression fails naming the lane.
+#      and prove --demo-regression fails naming the lane,
+#   7. the watch leg (scripts/watch_smoke.sh): a forced SLO breach must
+#      fire and resolve, an unmeetable SLO must keep `fedrec-obs alerts`
+#      / `tail --once` at exit 1, and the disabled path must leave zero
+#      watch footprint.
 #
 #   scripts/obs_smoke.sh     # or: make obs-smoke
 #
@@ -39,7 +43,7 @@ run() {
         XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
 }
 
-echo "== [1/6] 2-round CPU training run (DP + prefetch) =="
+echo "== [1/7] 2-round CPU training run (DP + prefetch) =="
 run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --synthetic --synthetic-train 512 --synthetic-news 128 \
     --mode joint --dp-epsilon 10 \
@@ -53,14 +57,14 @@ run python -m fedrec_tpu.cli.run 2 16 2 --strategy param_avg --clients 8 \
     --set train.eval_protocol=sampled > "$OUT/train.log" 2>&1 \
     || { tail -30 "$OUT/train.log"; exit 1; }
 
-echo "== [2/6] serve_load run =="
+echo "== [2/7] serve_load run =="
 run python benchmarks/serve_load.py --num-news 2000 --his-len 10 \
     --clients 4 --rate 50 --duration 2 --out obs_smoke_serve_load.json \
     --obs-dir "$OUT/serve" > "$OUT/serve.log" 2>&1 \
     || { tail -30 "$OUT/serve.log"; exit 1; }
 rm -f benchmarks/obs_smoke_serve_load.json
 
-echo "== [3/6] artifact assertions =="
+echo "== [3/7] artifact assertions =="
 for d in train serve; do
     for f in metrics.jsonl trace.json prometheus.txt; do
         [ -s "$OUT/$d/$f" ] || { echo "MISSING $OUT/$d/$f"; exit 1; }
@@ -123,7 +127,7 @@ assert any(e["name"] == "fed_round" and e["args"].get("worker") == "0"
 print("  fleet: 2 rounds attributed to worker 0, merged trace valid")
 EOF
 
-echo "== [4/6] forced-NaN flight-recorder round-trip =="
+echo "== [4/7] forced-NaN flight-recorder round-trip =="
 # inf lr: the first optimizer update goes non-finite, the sentry trips,
 # the run must ABORT (nonzero exit) after dumping forensics
 if run python -m fedrec_tpu.cli.run 2 16 1000 --strategy param_avg --clients 8 \
@@ -150,10 +154,10 @@ grep -q "REPRODUCED" "$OUT/replay.log" \
     || { echo "replay verdict missing"; tail -5 "$OUT/replay.log"; exit 1; }
 echo "  forced-NaN: abort + complete flightrec dump + replay REPRODUCED"
 
-echo "== [5/6] model-quality smoke (scripts/quality_smoke.sh) =="
+echo "== [5/7] model-quality smoke (scripts/quality_smoke.sh) =="
 QUALITY_SMOKE_DIR="$OUT/quality" bash scripts/quality_smoke.sh
 
-echo "== [6/6] perf telemetry + perf-regression gate =="
+echo "== [6/7] perf telemetry + perf-regression gate =="
 # the training run of leg 1 carried obs.perf.enabled + capture_rounds=1:
 # the report must render a Perf section, the perf verb must exit 0, and
 # the capture window's jax.profiler trace must have landed in obs.dir
@@ -188,4 +192,7 @@ fi
 grep -q "REGRESSION lane steps_per_sec" "$OUT/perf_gate.log" \
     || { echo "gate failure did not name the lane"; tail -5 "$OUT/perf_gate.log"; exit 1; }
 echo "  perf gate: banked + clean pass + forced regression names the lane"
+
+echo "== [7/7] continuous-watch smoke (scripts/watch_smoke.sh) =="
+WATCH_SMOKE_DIR="$OUT/watch" bash scripts/watch_smoke.sh
 echo "OBS_SMOKE=PASS"

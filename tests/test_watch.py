@@ -1,0 +1,582 @@
+"""Continuous watch layer (``fedrec_tpu.obs.watch`` + ``obs.alerts``):
+SLO spec parsing, hand-exact multi-window burn rates, per-evaluation
+histogram delta reads, the anomaly detector's changepoint behaviour
+(silent before, fires at it, self-resolves after), alert lifecycle
+dedup/flap suppression, the unified trigger pulses, fleet rules on
+hand-made telemetry pushes, the serving admin ``{"cmd": "alerts"}``
+contract pin, and the acceptance pin that ``obs.slo.enabled=false``
+keeps the training trajectory byte-identical with zero ``alert.*``
+instruments."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.config import SloConfig, WatchConfig
+from fedrec_tpu.obs import MetricsRegistry, Tracer, set_registry, set_tracer
+from fedrec_tpu.obs.alerts import AlertEngine
+from fedrec_tpu.obs.watch import (
+    PERF_DROP_KEY,
+    AnomalyDetector,
+    BurnRateEvaluator,
+    FleetRules,
+    SloObjective,
+    Watch,
+    active_alerts,
+    alert_records,
+    parse_slo_spec,
+)
+
+from test_train import make_setup, small_cfg
+
+
+@pytest.fixture()
+def fresh_obs():
+    reg, tr = MetricsRegistry(), Tracer()
+    old_reg, old_tr = set_registry(reg), set_tracer(tr)
+    try:
+        yield reg, tr
+    finally:
+        set_registry(old_reg)
+        set_tracer(old_tr)
+
+
+# ------------------------------------------------------------- spec grammar
+def test_parse_slo_spec_grammar():
+    spec = (
+        "round_time:train.round_seconds:p95<2.5; "
+        "auc_floor:eval.auc{slice=cold_user}>=0.55@0.9"
+    )
+    rt, auc = parse_slo_spec(spec)
+    assert rt.name == "round_time" and rt.metric == "train.round_seconds"
+    assert rt.quantile == pytest.approx(0.95) and rt.op == "<"
+    assert rt.threshold == 2.5 and rt.target == 0.99  # default budget
+    assert rt.labels == {}
+    assert auc.labels == {"slice": "cold_user"} and auc.op == ">="
+    assert auc.quantile is None and auc.target == pytest.approx(0.9)
+    assert auc.describe() == "eval.auc{slice=cold_user}>=0.55"
+    assert rt.good(2.4) and not rt.good(2.5)
+    assert auc.good(0.55) and not auc.good(0.54)
+    assert parse_slo_spec("") == []
+
+
+def test_parse_slo_spec_rejects_malformed():
+    with pytest.raises(ValueError, match="bad obs.slo.objectives entry"):
+        parse_slo_spec("nonsense")
+    with pytest.raises(ValueError, match="duplicate obs.slo.objectives name"):
+        parse_slo_spec("x:a<1;x:b<2")
+    with pytest.raises(ValueError, match="quantile"):
+        parse_slo_spec("x:a:p0<1")
+    with pytest.raises(ValueError, match="target"):
+        parse_slo_spec("x:a<1@1.0")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_slo_spec("x:a{noequals}<1")
+
+
+# -------------------------------------------------------- burn-rate windows
+def test_burn_rate_windows_hand_exact():
+    """target 0.9 -> budget 0.1; fast window 2, slow window 4.  Every
+    burn value below is hand-computed: burn = bad_fraction / 0.1."""
+    o = SloObjective(name="lat", metric="m", op="<", threshold=1.0, target=0.9)
+    ev = BurnRateEvaluator(o, fast_window=2, slow_window=4,
+                           fast_burn=5.0, slow_burn=2.5)
+
+    v = ev.observe(0.5)                       # good: [G]
+    assert v["fast_burn"] == 0.0 and v["slow_burn"] == 0.0
+    assert not v["breached"]
+
+    v = ev.observe(2.0)                       # [G B]: fast 1/2, slow 1/2
+    assert v["fast_burn"] == pytest.approx(5.0)
+    assert v["slow_burn"] == pytest.approx(5.0)
+    assert v["breached"]                      # 5.0 >= 5.0 and 5.0 >= 2.5
+
+    v = ev.observe(2.0)                       # [G B B]: fast 2/2, slow 2/3
+    assert v["fast_burn"] == pytest.approx(10.0)
+    assert v["slow_burn"] == pytest.approx(2.0 / 3.0 / 0.1)
+    assert v["breached"]
+
+    v = ev.observe(0.5)                       # [G B B G]: fast 1/2, slow 2/4
+    assert v["fast_burn"] == pytest.approx(5.0)
+    assert v["slow_burn"] == pytest.approx(5.0)
+    assert v["breached"]
+
+    v = ev.observe(0.5)                       # rolls to [B B G G]: fast 0/2
+    assert v["fast_burn"] == 0.0
+    assert v["slow_burn"] == pytest.approx(5.0)
+    assert not v["breached"]                  # fast window recovered
+
+
+def test_burn_rate_needs_both_windows():
+    """The slow window keeps a brief blip from paging: one bad eval in a
+    long history breaches the fast condition but not the slow one."""
+    o = SloObjective(name="x", metric="m", op="<", threshold=1.0, target=0.99)
+    ev = BurnRateEvaluator(o, fast_window=1, slow_window=10,
+                           fast_burn=14.4, slow_burn=6.0)
+    for _ in range(9):
+        ev.observe(0.5)
+    v = ev.observe(2.0)                       # fast 1/1 -> 100x; slow 1/10 -> 10x
+    assert v["fast_burn"] == pytest.approx(100.0)
+    assert v["slow_burn"] == pytest.approx(10.0)
+    assert v["breached"]
+    ev2 = BurnRateEvaluator(o, fast_window=1, slow_window=10,
+                            fast_burn=14.4, slow_burn=11.0)
+    for _ in range(9):
+        ev2.observe(0.5)
+    assert not ev2.observe(2.0)["breached"]   # slow 10x < 11x: no page
+
+
+# --------------------------------------------------- histogram delta reads
+def test_watch_reads_histogram_as_per_eval_delta(fresh_obs):
+    """The SLO scores THIS evaluation's observations (bucket-count
+    deltas), not the lifetime distribution — and an evaluation with no
+    new samples skips the objective instead of re-scoring stale data."""
+    reg, tr = fresh_obs
+    slo = SloConfig(enabled=True, objectives="rt:lat_ms:p50<10",
+                    fast_window=1, slow_window=1)
+    w = Watch(slo, WatchConfig(anomaly=False, pending_for=1, resolve_after=1),
+              registry=reg, tracer=tr)
+    h = reg.histogram("lat_ms", "", buckets=(1.0, 5.0, 25.0))
+
+    h.observe(2.0)
+    assert w.evaluate() == []                 # p50 of this round's delta = ok
+
+    for _ in range(3):
+        h.observe(30.0)                       # all NEW samples are bad
+    (alert,) = w.evaluate()
+    assert alert["key"] == "slo:rt" and alert["state"] == "firing"
+    # burn gauges carry the last verdict: 1.0 bad fraction / 0.01 budget
+    assert reg.gauge("alert.slo_burn_rate", labels=("slo", "window")).value(
+        slo="rt", window="fast") == pytest.approx(100.0)
+
+    # no new samples: the objective is skipped, the alert stays firing
+    (alert,) = w.evaluate()
+    assert alert["state"] == "firing"
+
+    h.observe(2.0)                            # recovery round
+    assert w.evaluate() == []
+
+
+def test_watch_slo_over_record_and_counter(fresh_obs):
+    """Record keys read at face value; counters as per-evaluation deltas."""
+    reg, tr = fresh_obs
+    slo = SloConfig(
+        enabled=True,
+        objectives="auc:eval.auc>=0.5@0.5; misses:lease.misses_total<=0@0.5",
+        fast_window=1, slow_window=1, fast_burn=1.0, slow_burn=1.0,
+    )
+    w = Watch(slo, WatchConfig(anomaly=False, pending_for=1, resolve_after=1),
+              registry=reg, tracer=tr)
+    c = reg.counter("lease.misses_total", "")
+    assert w.evaluate(record={"eval.auc": 0.61}) == []
+    c.inc(2)
+    active = w.evaluate(record={"eval.auc": 0.41})
+    assert {a["key"] for a in active} == {"slo:auc", "slo:misses"}
+    # counter delta drops back to 0 without new increments -> both resolve
+    assert w.evaluate(record={"eval.auc": 0.61}) == []
+
+
+# ------------------------------------------------------------ anomaly net
+def test_anomaly_detector_changepoint():
+    """Silent through a stable alternating series, fires exactly at the
+    injected changepoint, and self-resolves once the new level becomes
+    the EWMA baseline."""
+    det = AnomalyDetector(alpha=0.3, window=8, z=6.0, warmup=4)
+    for i in range(12):
+        assert det.observe("loss", 1.01 if i % 2 else 0.99) is None
+    hit = det.observe("loss", 5.0)            # the changepoint
+    assert hit is not None and hit["series"] == "loss"
+    assert hit["z"] > 6.0 and hit["baseline"] == pytest.approx(1.0, abs=0.05)
+    fired_again = sum(
+        det.observe("loss", 5.0) is not None for _ in range(20)
+    )
+    assert det.observe("loss", 5.0) is None   # new regime is the baseline
+    assert fired_again < 20                   # adaptation, not a stuck alarm
+
+
+def test_anomaly_detector_constant_series_silent():
+    det = AnomalyDetector(alpha=0.3, window=8, z=6.0, warmup=4)
+    for _ in range(50):
+        assert det.observe("flat", 1.0) is None  # MAD floor beats jitter
+
+
+# ------------------------------------------------------- lifecycle engine
+def test_engine_pending_firing_resolved_dedup(fresh_obs):
+    reg, tr = fresh_obs
+    eng = AlertEngine(registry=reg, tracer=tr, pending_for=2, resolve_after=2)
+
+    a = eng.observe("k", True, severity="critical", summary="s")
+    assert a.state == "pending"
+    assert eng.records_since(0) == ([], 0)    # pending emits nothing
+    a = eng.observe("k", True)
+    assert a.state == "firing"
+    recs, idx = eng.records_since(0)
+    assert [r["event"] for r in recs] == ["firing"]
+    eng.observe("k", True)                    # dedup: state, not event
+    eng.observe("k", False)                   # 1 of 2 clears: still firing
+    assert eng.records_since(idx) == ([], idx)
+    assert eng.firing() and eng.active()[0]["state"] == "firing"
+    assert reg.gauge("alert.firing").value() == 1.0
+
+    eng.observe("k", False)                   # 2nd clear: resolved
+    recs, idx2 = eng.records_since(idx)       # disjoint catch-up slice
+    assert [r["event"] for r in recs] == ["resolved"]
+    assert eng.active() == [] and len(eng.history()) == 1
+    assert reg.counter("alert.transitions_total", labels=("state",)).value(
+        state="firing") == 1
+    assert reg.counter("alert.transitions_total", labels=("state",)).value(
+        state="resolved") == 1
+    assert reg.gauge("alert.firing").value() == 0.0
+
+    # a pending alert that clears before confirming never fired at all
+    eng.observe("blip", True)
+    assert eng.observe("blip", False) is None
+    assert eng.records_since(idx2) == ([], idx2)
+
+    # per-call override: pulse-style triggers fire on the first breach
+    a = eng.observe("pulse", True, pending_for=1)
+    assert a.state == "firing"
+
+
+def test_engine_flap_suppression(fresh_obs):
+    """flap_max fire cycles inside flap_window mute BOTH the fire and its
+    resolve — no half-pairs in the record stream."""
+    reg, tr = fresh_obs
+    eng = AlertEngine(registry=reg, tracer=tr, pending_for=1, resolve_after=1,
+                      flap_max=2, flap_window=100)
+    for _ in range(2):                        # two full loud cycles
+        eng.observe("osc", True)
+        eng.observe("osc", False)
+    recs, idx = eng.records_since(0)
+    assert [r["event"] for r in recs] == ["firing", "resolved"] * 2
+
+    eng.observe("osc", True)                  # third cycle: muted
+    eng.observe("osc", False)
+    assert eng.records_since(idx) == ([], idx)
+    assert reg.counter("alert.flaps_suppressed_total").value() == 1
+    # suppression still tracks state: the gauge saw it fire and resolve
+    assert reg.gauge("alert.firing").value() == 0.0
+
+
+# --------------------------------------------------- unified trigger paths
+def test_watch_pulse_fires_and_autoclears(fresh_obs):
+    reg, tr = fresh_obs
+    w = Watch(SloConfig(enabled=True),
+              WatchConfig(anomaly=False, resolve_after=1),
+              registry=reg, tracer=tr)
+    w.ingest_health_trigger(
+        {"kind": "loss_spike", "round": 3, "client": 1, "round_loss": 9.0}
+    )
+    (alert,) = w.evaluate()
+    assert alert["key"] == "health:loss_spike" and alert["state"] == "firing"
+    assert "round 3" in alert["summary"] and "client 1" in alert["summary"]
+    assert w.evaluate() == []                 # pulse stopped -> auto-clear
+
+
+def test_watch_drift_and_outlier_pulses(fresh_obs):
+    reg, tr = fresh_obs
+    w = Watch(SloConfig(enabled=True),
+              WatchConfig(anomaly=False, drift_churn_max=0.5, resolve_after=1),
+              registry=reg, tracer=tr)
+    w.ingest_drift({"drift_rank_churn": 0.2})     # under the ceiling
+    assert w.evaluate() == []
+    w.ingest_drift({"drift_rank_churn": 0.9})
+    w.ingest_quality_outliers(
+        [{"client": 7, "auc": 0.41, "cohort_median": 0.63}]
+    )
+    w.ingest_health_outliers(
+        [{"client": 2, "update_norm": 40.0, "cohort_median": 2.0}]
+    )
+    keys = {a["key"] for a in w.evaluate()}
+    assert keys == {"serve:drift", "quality:outlier_clients",
+                    "health:outlier_clients"}
+
+
+def test_watch_bind_perf_arms_capture_on_firing(fresh_obs):
+    """The perf efficiency-drop trigger rides the unified path: the
+    PerfMonitor hook pulses, and the capture arms off the alert's FIRING
+    transition (not the raw trigger)."""
+    reg, tr = fresh_obs
+
+    class FakePerf:
+        watch_hook = None
+        armed = 0
+
+        def arm_capture(self):
+            self.armed += 1
+            return True
+
+    perf = FakePerf()
+    w = Watch(SloConfig(enabled=True),
+              WatchConfig(anomaly=False, resolve_after=1),
+              registry=reg, tracer=tr)
+    w.bind_perf(perf)
+    perf.watch_hook(4, 120.0, 900.0)          # what PerfMonitor calls
+    assert perf.armed == 0                    # pulse alone arms nothing
+    (alert,) = w.evaluate()
+    assert alert["key"] == PERF_DROP_KEY and perf.armed == 1
+    assert "120.0" in alert["summary"]
+
+
+# ------------------------------------------------------------- fleet rules
+def _snap(round_sum=None, round_count=None, rounds=None, version=None,
+          quorum=None, ts=None):
+    """Hand-made registry snapshot with just the cells FleetRules reads."""
+    metrics = {}
+    if round_sum is not None:
+        metrics["train.round_seconds"] = {"kind": "histogram", "values": [
+            {"labels": {}, "sum": round_sum, "count": round_count},
+        ]}
+    if rounds is not None:
+        metrics["train.rounds_total"] = {"kind": "counter", "values": [
+            {"labels": {}, "value": rounds},
+        ]}
+    if version is not None:
+        metrics["agg.adopted_version"] = {"kind": "gauge", "values": [
+            {"labels": {}, "value": version},
+        ]}
+    if quorum is not None:
+        metrics["agg.quorum_wait_ms"] = {"kind": "gauge", "values": [
+            {"labels": {}, "value": quorum},
+        ]}
+    snap = {"kind": "registry_snapshot", "metrics": metrics}
+    if ts is not None:
+        snap["ts"] = ts
+    return snap
+
+
+def test_fleet_persistent_straggler(fresh_obs, tmp_path):
+    """A worker whose per-push mean round time exceeds factor x the fleet
+    median for straggler_evals consecutive pushes fires a named alert —
+    and resolves once it catches back up."""
+    reg, tr = fresh_obs
+    wc = WatchConfig(fleet_straggler_factor=2.0, fleet_straggler_evals=2,
+                     resolve_after=1)
+    jsonl = tmp_path / "metrics.jsonl"
+    rules = FleetRules(wc, registry=reg, tracer=tr, jsonl_path=jsonl)
+
+    # push 1: workers 0/1 run 1s rounds, worker 2 runs 10s rounds
+    rules.observe_push("0", _snap(1.0, 1))
+    rules.observe_push("1", _snap(1.0, 1))
+    rules.observe_push("2", _snap(10.0, 1))   # breach 1 of 2: pending
+    assert rules.engine.firing() == []
+    # push 2 (cumulative histogram cells): per-push deltas stay 1s vs 10s
+    rules.observe_push("0", _snap(2.0, 2))
+    rules.observe_push("1", _snap(2.0, 2))
+    rules.observe_push("2", _snap(20.0, 2))   # breach 2 of 2: fires
+    (alert,) = rules.engine.firing()
+    assert alert["key"] == "fleet:straggler:2"
+    assert "worker 2" in alert["summary"] and "10.00s" in alert["summary"]
+    rec = json.loads(jsonl.read_text().splitlines()[-1])
+    assert rec["kind"] == "alert" and rec["event"] == "firing"
+
+    rules.observe_push("2", _snap(21.0, 3))   # caught up: 1s this push
+    assert rules.engine.firing() == []
+
+
+def test_fleet_straggler_push_gap_signature(fresh_obs):
+    """The async signature: a worker that sleeps at the PUSH boundary
+    (chaos straggler) has ordinary round times but a push inter-arrival
+    gap far above the fleet's — the same alert fires off the snapshot
+    timestamps, no round histogram needed."""
+    reg, tr = fresh_obs
+    wc = WatchConfig(fleet_straggler_factor=2.0, fleet_straggler_evals=2,
+                     resolve_after=1)
+    rules = FleetRules(wc, registry=reg, tracer=tr)
+    # everyone pushes at t, t+1, t+2…; worker 2 arrives 5 s apart
+    for i, t in enumerate((100.0, 101.0, 102.0)):
+        rules.observe_push("0", _snap(ts=t))
+        rules.observe_push("1", _snap(ts=t + 0.1))
+        rules.observe_push("2", _snap(ts=100.2 + i * 5.0))
+    (alert,) = rules.engine.firing()
+    assert alert["key"] == "fleet:straggler:2"
+    assert alert["labels"]["signal"] == "push gap"
+    assert "mean push gap 5.00s" in alert["summary"]
+
+
+def test_fleet_world_below_target(fresh_obs):
+    reg, tr = fresh_obs
+    rules = FleetRules(WatchConfig(resolve_after=1), target_world=4,
+                       registry=reg, tracer=tr)
+    rules.observe_world(2)                    # forming up: not armed yet
+    assert rules.engine.active() == []
+    rules.observe_world(4)                    # reached the target once
+    rules.observe_world(3)                    # now a drop is an incident
+    (alert,) = rules.engine.firing()
+    assert alert["key"] == "fleet:world_below_target"
+    assert "world 3 below target 4" in alert["summary"]
+    rules.observe_world(4)
+    assert rules.engine.firing() == []
+
+
+def test_fleet_quorum_wait_growth(fresh_obs):
+    reg, tr = fresh_obs
+    rules = FleetRules(WatchConfig(fleet_quorum_factor=3.0, resolve_after=1),
+                       registry=reg, tracer=tr)
+    for _ in range(4):                        # trailing median builds first
+        rules.observe_push("0", _snap(quorum=10.0))
+    assert rules.engine.active() == []
+    rules.observe_push("0", _snap(quorum=100.0))  # 10x the trailing median
+    (alert,) = rules.engine.firing()
+    assert alert["key"] == "fleet:quorum_wait_growth"
+    assert "100 ms" in alert["summary"]
+
+
+def test_fleet_stalled_commit_version(fresh_obs):
+    """Rounds advance while the adopted global version doesn't — but only
+    once a commit was EVER adopted (sync runs stay silent forever)."""
+    reg, tr = fresh_obs
+    rules = FleetRules(WatchConfig(fleet_stalled_pushes=2, resolve_after=1),
+                       registry=reg, tracer=tr)
+    # a sync worker: version pinned at 0, rounds advancing -> never armed
+    for r in range(1, 5):
+        rules.observe_push("sync", _snap(rounds=r, version=0))
+    assert rules.engine.active() == []
+
+    rules.observe_push("0", _snap(rounds=1, version=1))   # commit adopted
+    rules.observe_push("0", _snap(rounds=2, version=2))   # advancing: fine
+    rules.observe_push("0", _snap(rounds=3, version=2))   # stall 1 of 2
+    assert rules.engine.firing() == []
+    rules.observe_push("0", _snap(rounds=4, version=2))   # stall 2: fires
+    (alert,) = rules.engine.firing()
+    assert alert["key"] == "fleet:stalled_commit:0"
+    assert "worker 0" in alert["summary"]
+    rules.observe_push("0", _snap(rounds=5, version=3))   # commits resumed
+    assert rules.engine.firing() == []
+
+
+# ---------------------------------------------------------- record readers
+def test_alert_record_readers():
+    records = [
+        {"kind": "metrics", "ts": 1.0},
+        {"kind": "alert", "event": "firing", "key": "a", "ts": 3.0},
+        {"kind": "alert", "event": "firing", "key": "b", "ts": 2.0},
+        {"kind": "alert", "event": "resolved", "key": "a", "ts": 4.0},
+    ]
+    assert [r["ts"] for r in alert_records(records)] == [2.0, 3.0, 4.0]
+    (active,) = active_alerts(records)        # a resolved; b still firing
+    assert active["key"] == "b"
+
+
+# ------------------------------------------- serving admin contract pin
+def test_serving_admin_alerts_cmd(fresh_obs):
+    """`{"cmd": "alerts"}` is part of the admin contract: the empty shape
+    without a watch, the engine's active+recent state with one — and the
+    pre-existing commands keep answering (strict superset, like the
+    metrics-key pin in test_obs_serving)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedrec_tpu.config import ExperimentConfig
+    from fedrec_tpu.models import NewsRecommender
+    from fedrec_tpu.serving import EmbeddingStore, ServingService
+
+    reg, tr = fresh_obs
+    cfg = ExperimentConfig()
+    cfg.model.bert_hidden = 32
+    cfg.model.news_dim = 32
+    cfg.model.num_heads = 4
+    cfg.model.head_dim = 8
+    cfg.model.query_dim = 16
+    model = NewsRecommender(cfg.model)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.standard_normal((50, 32)).astype(np.float32))
+    dummy = jnp.zeros((1, 10, 32), jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), dummy, method=NewsRecommender.encode_user
+    )["params"]["user_encoder"]
+    store = EmbeddingStore(registry=reg)
+    store.publish(table, params, round=1, source="synthetic")
+    service = ServingService(model, store, history_len=10, top_k=5,
+                             batch_sizes=(1,), registry=reg)
+
+    resp = asyncio.run(service._admin({"cmd": "alerts"}))
+    assert resp == {"alerts": {"active": [], "recent": []}}
+
+    service.watch = Watch(
+        SloConfig(enabled=True), WatchConfig(anomaly=False),
+        registry=reg, tracer=tr,
+    )
+    service.watch.engine.observe(
+        "slo:serve_p99", True, severity="critical", summary="p99 burning",
+        pending_for=1,
+    )
+    resp = asyncio.run(service._admin({"cmd": "alerts"}))
+    assert set(resp["alerts"]) == {"active", "recent"}
+    (active,) = resp["alerts"]["active"]
+    assert active["key"] == "slo:serve_p99" and active["state"] == "firing"
+    # existing admin commands still answer (superset, not replacement)
+    assert "metrics" in asyncio.run(service._admin({"cmd": "metrics"}))
+    assert "prometheus" in asyncio.run(service._admin({"cmd": "prometheus"}))
+
+
+# ------------------------------------------------- trainer acceptance pin
+def _run_small_trainer(tmp_path, tag, slo_enabled, rounds=2):
+    cfg = small_cfg(optim__user_lr=3e-3)
+    cfg.model.text_encoder_mode = "head"
+    cfg.fed.strategy = "param_avg"
+    cfg.fed.num_clients = 4
+    cfg.fed.rounds = rounds
+    cfg.train.snapshot_dir = str(tmp_path / f"snap_{tag}")
+    cfg.train.save_every = 1000
+    cfg.train.eval_every = rounds
+    cfg.obs.slo.enabled = slo_enabled
+    cfg.obs.slo.objectives = "rt:train.round_seconds:p95<1e9"
+    data, _, token_states, _, _, _ = make_setup(cfg, num_train=64, seed=0)
+    from fedrec_tpu.train.trainer import Trainer
+
+    t = Trainer(cfg, data, np.asarray(token_states))
+    t.run()
+    return t
+
+
+def test_trainer_watch_disabled_is_byte_identical(tmp_path):
+    """The acceptance pin: the watch layer is OBSERVATIONAL — an enabled
+    run's trajectory is bit-identical to a disabled run's, and a disabled
+    run constructs no Watch and registers no alert.* instrument."""
+    import jax
+
+    reg1, tr1 = MetricsRegistry(), Tracer()
+    old_reg, old_tr = set_registry(reg1), set_tracer(tr1)
+    try:
+        t_off = _run_small_trainer(tmp_path, "off", slo_enabled=False)
+        off_leaves = [
+            np.asarray(x) for x in jax.tree_util.tree_leaves(
+                (t_off.state.user_params, t_off.state.news_params)
+            )
+        ]
+        assert t_off.watch is None
+        assert not any(
+            name.startswith("alert.")
+            for name in reg1.snapshot()["metrics"]
+        )
+    finally:
+        set_registry(old_reg)
+        set_tracer(old_tr)
+
+    reg2, tr2 = MetricsRegistry(), Tracer()
+    old_reg, old_tr = set_registry(reg2), set_tracer(tr2)
+    try:
+        t_on = _run_small_trainer(tmp_path, "on", slo_enabled=True)
+        on_leaves = [
+            np.asarray(x) for x in jax.tree_util.tree_leaves(
+                (t_on.state.user_params, t_on.state.news_params)
+            )
+        ]
+        assert t_on.watch is not None
+        names = reg2.snapshot()["metrics"]
+        assert "alert.evaluations_total" in names
+        assert "alert.firing" in names
+        # the sky-high threshold never breached: evaluations ran, no alert
+        assert reg2.counter("alert.evaluations_total").value() >= 2
+        assert t_on.watch.engine.active() == []
+    finally:
+        set_registry(old_reg)
+        set_tracer(old_tr)
+
+    for a, b in zip(off_leaves, on_leaves):
+        np.testing.assert_array_equal(a, b)
