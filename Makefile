@@ -1,7 +1,7 @@
 # Convenience targets. The native C++ data engine has its own Makefile
 # (native/Makefile); this one is for repo-level workflows.
 
-.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate agg-scale async-smoke watch-smoke
+.PHONY: t1 lint check native obs-smoke chaos-smoke shard-smoke elastic-smoke comm-cost pallas-bench table-capacity quality-gate quality-smoke perf-gate agg-scale async-smoke watch-smoke churn-soak
 
 # tier-1 verify: the ROADMAP.md pipeline, DOTS_PASSED count included
 t1:
@@ -86,6 +86,18 @@ agg-scale:
 # it the full straggle)
 async-smoke:
 	@bash scripts/async_smoke.sh
+
+# partition-tolerance soak: 104 wire workers against a live commit
+# authority + membership service through a seeded churn schedule (10%
+# kills, half rejoining, a full partition window on one cohort's edge,
+# in-flight push duplication on another, an authority kill/respawn from
+# its state sidecars mid-run) — asserts monotone commit liveness, zero
+# acked-push loss via ledger reconciliation, bounded folded staleness,
+# duplicate detection without re-folding, incarnation-2 recovery, and
+# the fleet watch layer naming the partitioned edge; banks
+# benchmarks/churn_soak.json
+churn-soak:
+	@python benchmarks/churn_soak.py
 
 # continuous-watch smoke: a forced SLO breach (tight round-time objective
 # the JIT compile round blows through) must fire AND resolve through the

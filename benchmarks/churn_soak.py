@@ -1,0 +1,532 @@
+"""Churn soak: 100+ logical wire workers vs a live commit authority.
+
+The partition-tolerance acceptance drive (`make churn-soak`): a fleet of
+lightweight WIRE workers — each owning a resilient
+:class:`~fedrec_tpu.parallel.rpc.FleetRpc` edge, pushing tiny real
+contributions over real TCP — runs against a live
+``fedrec_tpu.agg.server`` commit authority and a live membership
+service, through a SEEDED churn schedule:
+
+* a cohort dials the authority through a chaos proxy that fully
+  PARTITIONS its edge for a window (``partition@T1-T2``),
+* a second cohort's pushes are DUPLICATED in flight (``dup@*`` — the
+  lost-ack re-delivery case the push ledger must absorb),
+* a third cohort's membership heartbeats ride a delayed edge,
+* a seeded ~10% of workers are killed mid-run (half rejoin later under
+  the same worker id),
+* the authority itself is killed and respawned from its state sidecars
+  mid-run (the crash-recovery handshake at fleet scale).
+
+The banked artifact (``benchmarks/churn_soak.json``) asserts the
+partition-tolerance contract:
+
+* **liveness** — the commit version observed by a monitor is monotone
+  non-decreasing across the restart and keeps advancing after it,
+* **zero acked-push loss** — every push a worker got an ack for is in
+  the final authority's ledger (exactly one terminal disposition) or
+  still pending a quorum; duplicated deliveries were detected
+  (``push_dups >= 1``), none double-folded,
+* **bounded staleness** — no commit folded an entry staler than
+  ``agg.staleness_cap``,
+* **recovery** — the respawned authority advertises incarnation 2 and
+  workers resynced to it,
+* **observability** — the fleet watch layer (PR-19 FleetRules) fired a
+  ``fleet:partition:`` alert NAMING the partitioned edge (worker ->
+  proxy address) during the window.
+
+Workers here are wire-protocol workers, not Trainers: the soak exercises
+the TRANSPORT and commit-authority state machine at a scale (and churn
+rate) real training loops cannot reach in CI time.  The full
+Trainer-driven path rides scripts/async_smoke.sh and
+tests/test_agg_recovery.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent))
+
+from fedrec_tpu.agg.commit import CommitPolicy              # noqa: E402
+from fedrec_tpu.agg.server import AggServer, encode_leaves  # noqa: E402
+from fedrec_tpu.config import WatchConfig                   # noqa: E402
+from fedrec_tpu.fed.chaos import ChaosProxy, WireFaultPlan  # noqa: E402
+from fedrec_tpu.obs.fleet import (                          # noqa: E402
+    CollectorServer,
+    TelemetryCollector,
+    request_json_line,
+)
+from fedrec_tpu.obs.watch import FleetRules, alert_records  # noqa: E402
+from fedrec_tpu.parallel.membership import MembershipServer  # noqa: E402
+from fedrec_tpu.parallel.rpc import (                       # noqa: E402
+    FleetRpc,
+    RpcPolicy,
+    new_push_id,
+)
+from fedrec_tpu.utils.provenance import provenance          # noqa: E402
+
+LEAF_SHAPES = ((64,), (32,))   # tiny real contribution leaves
+
+
+def _leaves(rng: np.random.Generator) -> list[np.ndarray]:
+    return [
+        rng.standard_normal(s).astype(np.float32) * 0.01 for s in LEAF_SHAPES
+    ]
+
+
+def _policy(worker: str, seed: int) -> RpcPolicy:
+    return RpcPolicy(
+        connect_timeout_s=2.0, read_timeout_s=8.0, attempts=3,
+        backoff_base_ms=25.0, backoff_max_ms=400.0,
+        breaker_threshold=4, breaker_reset_s=1.5,
+        seed=zlib.crc32(worker.encode()) ^ seed,
+    )
+
+
+class SoakWorker(threading.Thread):
+    """One logical wire worker: push loop + heartbeat + telemetry."""
+
+    def __init__(self, wid, auth_addr, mem_addr, coll_addr, seed, stop_all):
+        super().__init__(name=f"soak-{wid}", daemon=True)
+        self.wid = str(wid)
+        host, port = str(auth_addr).rsplit(":", 1)
+        self.rpc = FleetRpc(host, int(port), _policy(self.wid, seed))
+        self.mem_addr = mem_addr
+        self.coll_addr = coll_addr
+        self.rng = np.random.default_rng([seed, zlib.crc32(wid.encode())])
+        self.stop_me = threading.Event()
+        self.stop_all = stop_all
+        self.acked: dict[str, dict] = {}    # push_id -> ack reply
+        self.dup_acks = 0
+        self.resyncs = 0
+        self.version = 0
+        self.incarnation: int | None = None
+        self.rounds = 0
+        self.joined = False
+        self.mem_epoch = -1
+        self.errors: list[str] = []
+
+    # ------------------------------------------------------------- wire ops
+    def _note(self, resp: dict) -> None:
+        adv = resp.get("incarnation")
+        if adv is None:
+            return
+        adv = int(adv)
+        if self.incarnation is not None and adv != self.incarnation:
+            self.resyncs += 1
+            try:
+                self.rpc.call(
+                    {"cmd": "hello", "worker": self.wid, "epoch": 0},
+                    op="hello",
+                )
+                g = self.rpc.call({"cmd": "global", "since": -1}, op="global")
+                self.version = int(g.get("version", self.version))
+            except OSError:
+                pass
+        self.incarnation = adv
+
+    def _membership(self, cmd: str) -> None:
+        host, port = self.mem_addr.rsplit(":", 1)
+        try:
+            if cmd == "join":
+                resp = request_json_line(
+                    host, int(port),
+                    {"cmd": "join", "worker": self.wid, "coord": ""},
+                    timeout_s=60.0, connect_timeout_s=2.0,
+                )
+                self.mem_epoch = int(resp.get("epoch", -1))
+                self.joined = True
+            else:
+                resp = request_json_line(
+                    host, int(port),
+                    {"cmd": "heartbeat", "worker": self.wid,
+                     "epoch": self.mem_epoch},
+                    timeout_s=5.0, connect_timeout_s=2.0,
+                )
+                if resp.get("reform"):
+                    self.joined = False
+        except (OSError, ValueError):
+            pass
+
+    def _telemetry(self) -> None:
+        host, port = self.coll_addr.rsplit(":", 1)
+        snap = {
+            "kind": "registry_snapshot",
+            "ts": time.time(),
+            "metrics": {
+                **self.rpc.wire_snapshot_rows(),
+                "agg.adopted_version": {
+                    "kind": "gauge",
+                    "values": [{"labels": {}, "value": float(self.version)}],
+                },
+                "train.rounds_total": {
+                    "kind": "counter",
+                    "values": [{"labels": {}, "value": float(self.rounds)}],
+                },
+            },
+        }
+        try:
+            request_json_line(
+                host, int(port),
+                {"cmd": "telemetry_push", "worker": self.wid,
+                 "snapshot": snap},
+                timeout_s=5.0, connect_timeout_s=2.0,
+            )
+        except (OSError, ValueError):
+            pass
+
+    # ---------------------------------------------------------------- loop
+    def run(self) -> None:
+        self._membership("join")
+        try:
+            hello = self.rpc.call(
+                {"cmd": "hello", "worker": self.wid, "epoch": 0}, op="hello"
+            )
+            self._note(hello)
+            self.version = int(hello.get("version", 0))
+            if not hello.get("have_global"):
+                self.rpc.call({
+                    "cmd": "init", "worker": self.wid,
+                    "payload": encode_leaves(
+                        [np.zeros(s, np.float32) for s in LEAF_SHAPES]
+                    ),
+                }, op="init")
+        except OSError:
+            pass   # bootstrap through a partition: the loop keeps probing
+        unacked: list[dict] = []
+        while not (self.stop_me.is_set() or self.stop_all.is_set()):
+            time.sleep(float(self.rng.uniform(0.4, 1.0)))
+            req = {
+                "cmd": "push", "worker": self.wid, "round": self.rounds,
+                "epoch": 0, "based_on": self.version, "weight": 1.0,
+                "payload": encode_leaves(_leaves(self.rng)), "codec": "none",
+                "push_id": new_push_id(self.wid, self.rounds),
+            }
+            # backlog first, oldest first — each parked req keeps its id
+            for parked in list(unacked):
+                try:
+                    resp = self.rpc.call(parked, op="push")
+                except OSError:
+                    break
+                except ValueError:
+                    unacked.remove(parked)      # unfoldable after restart
+                    continue
+                unacked.remove(parked)
+                self._ack(parked, resp)
+            try:
+                resp = self.rpc.call(req, op="push")
+                self._ack(req, resp)
+            except OSError:
+                unacked.append(req)
+            except ValueError as e:
+                if "rebase" in str(e) or "ahead of" in str(e):
+                    self.resyncs += 1
+                    try:
+                        g = self.rpc.call(
+                            {"cmd": "global", "since": -1}, op="global"
+                        )
+                        self.version = int(g.get("version", 0))
+                    except OSError:
+                        pass
+                else:
+                    self.errors.append(str(e))
+            else:
+                try:
+                    g = self.rpc.call(
+                        {"cmd": "global", "since": self.version}, op="global"
+                    )
+                    if "payload" in g:
+                        self.version = int(g["version"])
+                    self._note(g)
+                except (OSError, ValueError):
+                    pass
+            self.rounds += 1
+            self._membership("heartbeat")
+            if not self.joined:
+                self._membership("join")
+            self._telemetry()
+        # exit: one last backlog attempt, then telemetry
+        for parked in list(unacked):
+            try:
+                self._ack(parked, self.rpc.call(parked, op="push"))
+            except (OSError, ValueError):
+                break
+        self._telemetry()
+
+    def _ack(self, req: dict, resp: dict) -> None:
+        self._note(resp)
+        if resp.get("duplicate"):
+            self.dup_acks += 1
+        self.acked[req["push_id"]] = {
+            "round": req["round"], "version": resp.get("version"),
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", type=int, default=104)
+    ap.add_argument("--duration-s", type=float, default=32.0)
+    ap.add_argument("--quorum", type=int, default=8)
+    ap.add_argument("--staleness-cap", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=str(HERE / "churn_soak.json"))
+    args = ap.parse_args()
+
+    t_run0 = time.time()
+    rng = np.random.default_rng(args.seed)
+    tmp = tempfile.mkdtemp(prefix="churn_soak_")
+    state_dir = Path(tmp) / "agg_state"
+    policy = CommitPolicy(quorum=args.quorum, staleness_cap=args.staleness_cap)
+
+    def spawn_authority(port: int = 0) -> AggServer:
+        return AggServer(
+            port=port, policy=policy, world=args.workers,
+            state_dir=str(state_dir),
+        ).start()
+
+    authority = spawn_authority()
+    auth_port = authority.port
+    membership = MembershipServer(
+        target_world=args.workers, lease_ms=6000.0, heartbeat_ms=1000.0,
+        formation_grace_ms=2000.0,
+    ).start()
+    collector = TelemetryCollector(Path(tmp) / "collector")
+    watch_cfg = WatchConfig()
+    watch_cfg.fleet_stalled_pushes = 3
+    fleet_jsonl = Path(tmp) / "collector" / "worker_fleet" / "metrics.jsonl"
+    fleet_jsonl.parent.mkdir(parents=True, exist_ok=True)
+    collector.rules = FleetRules(watch_cfg, jsonl_path=fleet_jsonl)
+    coll_srv = CollectorServer(collector).start()
+
+    # chaos proxies: one fully partitions its cohort's authority edge for
+    # a mid-run window, one duplicates every push (lost-ack re-delivery),
+    # one delays a cohort's membership heartbeats
+    t_part0, t_part1 = 8.0, 16.0
+    part_proxy = ChaosProxy(
+        "127.0.0.1", auth_port,
+        plan=WireFaultPlan(f"partition@{t_part0}-{t_part1}", seed=args.seed),
+    ).start()
+    dup_proxy = ChaosProxy(
+        "127.0.0.1", auth_port, plan=WireFaultPlan("dup@*", seed=args.seed)
+    ).start()
+    mem_proxy = ChaosProxy(
+        "127.0.0.1", membership.port,
+        plan=WireFaultPlan("delay@*:40", seed=args.seed),
+    ).start()
+    auth_addr = f"127.0.0.1:{auth_port}"
+    mem_addr = f"127.0.0.1:{membership.port}"
+
+    stop_all = threading.Event()
+    part_cohort = {f"w{i:03d}" for i in range(0, 6)}
+    dup_cohort = {f"w{i:03d}" for i in range(6, 10)}
+    slow_mem_cohort = {f"w{i:03d}" for i in range(10, 14)}
+    workers: dict[str, SoakWorker] = {}
+
+    def spawn(wid: str) -> SoakWorker:
+        w = SoakWorker(
+            wid,
+            part_proxy.address if wid in part_cohort
+            else dup_proxy.address if wid in dup_cohort
+            else auth_addr,
+            mem_proxy.address if wid in slow_mem_cohort else mem_addr,
+            coll_srv.address, args.seed, stop_all,
+        )
+        workers[wid] = w
+        w.start()
+        return w
+
+    for i in range(args.workers):
+        spawn(f"w{i:03d}")
+
+    # monitor: the liveness witness — polls the authority's status and
+    # records (t, version, incarnation); failed polls (restart window)
+    # are simply gaps
+    version_series: list[tuple[float, int, int]] = []
+
+    def monitor():
+        while not stop_all.is_set():
+            try:
+                st = request_json_line(
+                    "127.0.0.1", auth_port, {"cmd": "status"},
+                    timeout_s=3.0, connect_timeout_s=1.0,
+                )
+                version_series.append(
+                    (time.monotonic() - t0,
+                     int(st["version"]), int(st["incarnation"]))
+                )
+            except (OSError, ValueError, KeyError):
+                pass
+            time.sleep(0.3)
+
+    t0 = time.monotonic()
+    threading.Thread(target=monitor, daemon=True).start()
+
+    # ---- seeded churn schedule -----------------------------------------
+    kill_ids = sorted(
+        rng.choice(
+            [f"w{i:03d}" for i in range(14, args.workers)],
+            size=max(args.workers // 10, 1), replace=False,
+        )
+    )
+    rejoin_ids = kill_ids[: len(kill_ids) // 2]
+    t_kill, t_restart0, t_restart1, t_rejoin = 6.0, 12.0, 14.0, 18.0
+
+    def at(t_s: float) -> None:
+        time.sleep(max(t_s - (time.monotonic() - t0), 0.0))
+
+    at(t_kill)
+    for wid in kill_ids:
+        workers[wid].stop_me.set()
+    print(f"[churn-soak] t={t_kill:.0f}s killed {len(kill_ids)} workers")
+
+    at(t_restart0)
+    v_kill = authority.version
+    authority.stop()
+    print(f"[churn-soak] t={t_restart0:.0f}s authority killed at v{v_kill}")
+    at(t_restart1)
+    authority = spawn_authority(port=auth_port)
+    print(
+        f"[churn-soak] t={t_restart1:.0f}s authority respawned as "
+        f"incarnation {authority.incarnation} at v{authority.version}"
+    )
+
+    at(t_rejoin)
+    for wid in rejoin_ids:
+        spawn(wid)   # same id, fresh incarnation, rounds restart at 0
+    print(f"[churn-soak] t={t_rejoin:.0f}s rejoined {len(rejoin_ids)} workers")
+
+    at(args.duration_s)
+    stop_all.set()
+    for w in workers.values():
+        w.join(timeout=20.0)
+    final = authority.status()
+    authority.stop()
+    membership.stop()
+    coll_srv.stop()
+    part_proxy.stop()
+    dup_proxy.stop()
+    mem_proxy.stop()
+
+    # ---- assertions -----------------------------------------------------
+    checks: dict[str, dict] = {}
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        checks[name] = {"ok": bool(ok), "detail": detail}
+        print(f"[churn-soak] {'PASS' if ok else 'FAIL'} {name}: {detail}")
+
+    versions = [v for _, v, _ in version_series]
+    monotone = all(b >= a for a, b in zip(versions, versions[1:]))
+    check(
+        "liveness_monotone_commits",
+        bool(versions) and monotone and final["version"] > v_kill,
+        f"{len(versions)} samples, v{versions[0] if versions else '?'} -> "
+        f"v{final['version']} (restart at v{v_kill}), monotone={monotone}",
+    )
+
+    acked = {
+        pid for w in workers.values() for pid in w.acked
+    }
+    accounted = set(final["ledger"]) | set(final["pending_push_ids"])
+    lost = sorted(acked - accounted)
+    check(
+        "zero_acked_push_loss",
+        not lost,
+        f"{len(acked)} acked pushes, {len(final['ledger'])} ledgered, "
+        f"{len(final['pending_push_ids'])} pending, {len(lost)} lost"
+        + (f" ({lost[:3]}...)" if lost else ""),
+    )
+
+    max_staleness = max(
+        (c.get("max_staleness", 0) for c in final["commits"]), default=0
+    )
+    check(
+        "bounded_staleness",
+        max_staleness <= args.staleness_cap,
+        f"max folded staleness {max_staleness} <= cap {args.staleness_cap} "
+        f"over {len(final['commits'])} commits",
+    )
+
+    dup_detected = int(final["push_dups"])
+    check(
+        "duplicate_pushes_detected_not_refolded",
+        dup_detected >= 1,
+        f"authority detected {dup_detected} duplicate deliveries "
+        f"(dup-cohort edge injected {dup_proxy.injected.get('dup', 0)})",
+    )
+
+    resyncs = sum(w.resyncs for w in workers.values())
+    check(
+        "authority_recovery",
+        final["incarnation"] == 2 and resyncs >= 1,
+        f"final incarnation {final['incarnation']}, {resyncs} worker "
+        "resync(s) after the restart",
+    )
+
+    recs = []
+    if fleet_jsonl.exists():
+        with open(fleet_jsonl) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+    partition_alerts = [
+        r for r in alert_records(recs)
+        if r.get("event") == "firing"
+        and str(r.get("key", "")).startswith("fleet:partition:")
+    ]
+    named = {
+        (r.get("labels", {}).get("worker"), r.get("labels", {}).get("peer"))
+        for r in partition_alerts
+    }
+    check(
+        "partition_alert_names_the_edge",
+        any(
+            w in part_cohort and p == part_proxy.address for w, p in named
+        ),
+        f"{len(partition_alerts)} fleet:partition firing record(s); edges "
+        f"named: {sorted(named)[:4]} (expected peer {part_proxy.address})",
+    )
+
+    ok = all(c["ok"] for c in checks.values())
+    result = {
+        "kind": "churn_soak",
+        "ok": ok,
+        "workers": args.workers,
+        "killed": len(kill_ids),
+        "rejoined": len(rejoin_ids),
+        "quorum": args.quorum,
+        "staleness_cap": args.staleness_cap,
+        "seed": args.seed,
+        "duration_s": args.duration_s,
+        "final_version": final["version"],
+        "final_incarnation": final["incarnation"],
+        "commits": len(final["commits"]),
+        "acked_pushes": len(acked),
+        "ledgered_pushes": len(final["ledger"]),
+        "push_dups": dup_detected,
+        "worker_resyncs": resyncs,
+        "wire_faults_injected": {
+            "partition_edge": dict(part_proxy.injected),
+            "dup_edge": dict(dup_proxy.injected),
+            "membership_edge": dict(mem_proxy.injected),
+        },
+        "partition_alerts": len(partition_alerts),
+        "checks": checks,
+        "elapsed_s": round(time.time() - t_run0, 1),
+        "provenance": provenance(),
+    }
+    Path(args.out).write_text(json.dumps(result, indent=2))
+    print(f"[churn-soak] {'CHURN_SOAK=PASS' if ok else 'CHURN_SOAK=FAIL'} "
+          f"-> {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,10 @@ class BufferEntry:
     # sketch space (per-contribution codecs decode at push, so they
     # never appear here — their entries are already dense)
     codec: str = "none"
+    # client-generated idempotency token (parallel.rpc.new_push_id):
+    # retries of the same contribution reuse it, the commit authority's
+    # ledger folds a given id at most once. "" = pre-resilient-RPC push.
+    push_id: str = ""
 
 
 class AggBuffer:
@@ -88,16 +92,22 @@ class AggBuffer:
     def __len__(self) -> int:
         return len(self.entries)
 
-    def add(self, entry: BufferEntry) -> None:
+    def add(self, entry: BufferEntry) -> BufferEntry | None:
         """A worker re-pushing for the same round replaces its stale
         pending entry (retries after a torn connection must not double
-        its weight)."""
-        self.entries = [
-            e
-            for e in self.entries
-            if not (e.worker == entry.worker and e.round == entry.round)
-        ]
-        self.entries.append(entry)
+        its weight).  Returns the REPLACED entry when one existed (the
+        authority's push ledger accounts its ``push_id`` as superseded
+        — or as a duplicate delivery when the ids match), else None."""
+        replaced: BufferEntry | None = None
+        kept: list[BufferEntry] = []
+        for e in self.entries:
+            if e.worker == entry.worker and e.round == entry.round:
+                replaced = e
+            else:
+                kept.append(e)
+        kept.append(entry)
+        self.entries = kept
+        return replaced
 
     def pending_workers(self) -> set[str]:
         return {e.worker for e in self.entries}
@@ -166,6 +176,7 @@ class AggBuffer:
                     "arrival_ms": float(e.arrival_ms),
                     "num_leaves": len(e.leaves),
                     "codec": e.codec,
+                    "push_id": e.push_id,
                 }
                 for e in self.entries
             ],
@@ -228,6 +239,7 @@ class AggBuffer:
                         arrival_ms=float(ent["arrival_ms"]),
                         leaves=leaves,
                         codec=str(ent.get("codec", "none")),
+                        push_id=str(ent.get("push_id", "")),
                     )
                 )
             for k, res in enumerate(meta.get("residuals", [])):

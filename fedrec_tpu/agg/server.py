@@ -8,12 +8,15 @@ and speaks to this service over the fleet's TCP JSON-lines wire idiom
 (:func:`~fedrec_tpu.obs.fleet.serve_json_line`, the same exchange the
 membership service and telemetry collector use):
 
-    hello  {worker, epoch}                 -> {version, quorum, have_global}
-    init   {worker, payload}               -> {version}   (first caller seeds v0)
+    hello  {worker, epoch}                 -> {version, quorum, have_global,
+                                               incarnation}
+    init   {worker, payload}               -> {version, incarnation}
     push   {worker, round, epoch, based_on,
-            weight, payload[, codec]}      -> {version, committed}
-    global {since}                         -> {version[, payload]}
-    status {}                              -> commit/gate/buffer accounting
+            weight, payload[, codec]
+            [, push_id]}                   -> {version, committed,
+                                               incarnation[, duplicate]}
+    global {since}                         -> {version[, payload], incarnation}
+    status {}                              -> commit/gate/buffer/ledger accounting
 
 Payloads are base64 npz blobs of ORDERED leaf lists (the buffer's
 model-agnostic contract).  A push lands in the :class:`AggBuffer`; once
@@ -42,13 +45,29 @@ pins to ~0 (``scripts/async_smoke.sh`` asserts exactly this).
 
 Buffer state persists to ``--state-dir`` after every state change (the
 checkpoint sidecar discipline), so pending late contributions survive a
-service restart.
+service restart.  Crash recovery goes further: ``agg_global.npz`` beside
+the buffer sidecar carries ``{global leaves, version, incarnation,
+push ledger}`` at commit cadence, so a restarted authority RESUMES at
+the committed version instead of forgetting the global (the old
+"push before init" dead end).  Every reply advertises the authority's
+**incarnation** (a restart-bumped counter, also echoed in the reply
+envelope) — a worker seeing the bump re-hellos and resumes pushing.
+
+Pushes carry a client-generated idempotent ``push_id``
+(:func:`fedrec_tpu.parallel.rpc.new_push_id`); the authority's **push
+ledger** records each acked push's terminal disposition (``folded`` /
+``stale_dropped`` / ``superseded``) exactly once, and a re-delivered id
+that already reached a disposition is dropped as a duplicate
+(``agg.push_dups_total``) — retried and chaos-duplicated pushes can
+never double-fold.  ``benchmarks/churn_soak.py`` reconciles worker-side
+acks against this ledger for its zero-acked-push-loss claim.
 """
 
 from __future__ import annotations
 
 import base64
 import io
+import json
 import socket
 import threading
 import time
@@ -145,7 +164,15 @@ class AggServer:
         self.state_dir = state_dir
         self.version = 0
         self.global_leaves: list[np.ndarray] | None = None
+        # restart incarnation: bumps on every state-restoring start and
+        # rides every reply — workers re-hello when they see it change
+        self.incarnation = 1
         self.buffer = AggBuffer()
+        # push_id -> terminal disposition ({"disposition": ..., ...});
+        # an id present here is DONE — re-delivery is a duplicate
+        self._push_ledger: dict[str, dict] = {}
+        self._ledger_cap = 100_000
+        self._dup_pushes = 0
         self.commit_log: list[dict] = []
         self._arrival: dict[str, float] = {}   # pending worker -> arrival time
         self._gate_ms: dict[str, float] = {}   # worker -> LAST commit gate
@@ -162,6 +189,7 @@ class AggServer:
         self._threads: list[threading.Thread] = []
         self._instrument()
         self._restore()
+        self._g_incarnation.set(float(self.incarnation))
 
     # --------------------------------------------------------------- obs
     def _instrument(self) -> None:
@@ -206,6 +234,17 @@ class AggServer:
             "server-side fold time of the last commit (the 'fold' share "
             "of the queue/wire/fold commit-latency decomposition)",
         )
+        self._g_incarnation = reg.gauge(
+            "agg.incarnation",
+            "this commit authority's restart incarnation (bumps on every "
+            "state-restoring start; workers re-hello on a bump)",
+        )
+        self._m_dups = reg.counter(
+            "agg.push_dups_total",
+            "duplicate push deliveries dropped by push-id dedup (retries "
+            "after a lost ack, chaos duplication) — each acked push folds "
+            "at most once",
+        )
         self._m_push_bytes = reg.counter(
             "agg.push_bytes_total",
             "contribution wire bytes received per worker (base64 npz as "
@@ -228,12 +267,24 @@ class AggServer:
             pass  # a full disk must not take the commit authority down
 
     # ------------------------------------------------------- persistence
+    _GLOBAL_MAGIC = "fedrec-agg-global-v1"
+
     def _state_path(self):
         from pathlib import Path
 
         return Path(self.state_dir) / "agg_buffer.npz" if self.state_dir else None
 
+    def _global_path(self):
+        from pathlib import Path
+
+        return Path(self.state_dir) / "agg_global.npz" if self.state_dir else None
+
     def _persist(self) -> None:
+        """Caller holds the lock.  Two sidecars, written at commit/push
+        cadence: the pending buffer (``agg_buffer.npz``, pre-existing)
+        and the crash-recovery record (``agg_global.npz``: committed
+        global leaves + version + incarnation + the push ledger) — what a
+        restarted authority resumes from instead of forgetting the run."""
         path = self._state_path()
         if path is None:
             return
@@ -242,24 +293,78 @@ class AggServer:
         try:
             path.parent.mkdir(parents=True, exist_ok=True)
             atomic_write_bytes(path, self.buffer.state_bytes(0, self.version))
+            if self.global_leaves is not None:
+                meta = {
+                    "magic": self._GLOBAL_MAGIC,
+                    "version": int(self.version),
+                    "incarnation": int(self.incarnation),
+                    "num_leaves": len(self.global_leaves),
+                    "ledger": self._push_ledger,
+                }
+                buf = io.BytesIO()
+                np.savez(
+                    buf,
+                    __meta__=np.frombuffer(
+                        json.dumps(meta).encode(), np.uint8
+                    ),
+                    **{
+                        f"leaf{i}": np.asarray(x)
+                        for i, x in enumerate(self.global_leaves)
+                    },
+                )
+                atomic_write_bytes(self._global_path(), buf.getvalue())
         except OSError:
             pass
 
     def _restore(self) -> None:
         path = self._state_path()
-        if path is None or not path.exists():
+        if path is not None and path.exists():
+            try:
+                self.buffer, _, self.version = AggBuffer.load_state(
+                    path.read_bytes()
+                )
+                print(
+                    f"[aggserver] restored {len(self.buffer)} pending "
+                    f"contribution(s) at version {self.version}",
+                    flush=True,
+                )
+            except (ValueError, OSError) as e:
+                print(f"[aggserver] ignoring unreadable buffer sidecar: {e}",
+                      flush=True)
+        gpath = self._global_path()
+        if gpath is None or not gpath.exists():
             return
         try:
-            self.buffer, _, self.version = AggBuffer.load_state(
-                path.read_bytes()
-            )
+            with np.load(io.BytesIO(gpath.read_bytes())) as z:
+                meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+                if meta.get("magic") != self._GLOBAL_MAGIC:
+                    raise ValueError(
+                        f"not an agg-global sidecar "
+                        f"(magic={meta.get('magic')!r})"
+                    )
+                self.global_leaves = [
+                    np.asarray(z[f"leaf{i}"])
+                    for i in range(int(meta["num_leaves"]))
+                ]
+            # the global sidecar is written after every commit, so its
+            # version is the committed truth; the buffer sidecar rides
+            # along and can never be ahead of it
+            self.version = max(self.version, int(meta["version"]))
+            self.incarnation = int(meta.get("incarnation", 0)) + 1
+            ledger = meta.get("ledger") or {}
+            if isinstance(ledger, dict):
+                self._push_ledger = {
+                    str(k): dict(v) for k, v in ledger.items()
+                    if isinstance(v, dict)
+                }
             print(
-                f"[aggserver] restored {len(self.buffer)} pending "
-                f"contribution(s) at version {self.version}",
+                f"[aggserver] resumed committed global v{self.version} as "
+                f"incarnation {self.incarnation} "
+                f"({len(self._push_ledger)} ledgered push(es))",
                 flush=True,
             )
-        except (ValueError, OSError) as e:
-            print(f"[aggserver] ignoring unreadable buffer sidecar: {e}",
+        except (ValueError, OSError, KeyError) as e:
+            print(f"[aggserver] ignoring unreadable global sidecar: {e}",
                   flush=True)
 
     # ----------------------------------------------------------- serving
@@ -321,16 +426,35 @@ class AggServer:
             return self.status()
         return {"error": f"unknown cmd {cmd!r}"}
 
+    def _advertise(self) -> None:
+        """Echo the incarnation in the reply ENVELOPE too (additive —
+        response dicts carry it as a plain key either way)."""
+        if wireobs.current_envelope() is not None:
+            wireobs.serve_extra(incarnation=self.incarnation)
+
+    def _ledger_set(self, push_id: str, disposition: str, **kv) -> None:
+        """Caller holds the lock.  Record a push id's TERMINAL
+        disposition (exactly once per id — re-delivery after this is a
+        duplicate).  FIFO-trimmed at ``_ledger_cap``."""
+        if not push_id:
+            return
+        self._push_ledger[push_id] = {"disposition": disposition, **kv}
+        if len(self._push_ledger) > self._ledger_cap:
+            for k in list(self._push_ledger)[: self._ledger_cap // 2]:
+                del self._push_ledger[k]
+
     def _hello(self, worker: str, epoch: int) -> dict:
         with self._lock:
             self._workers.add(worker)
             world = self.world or len(self._workers)
             if epoch > self.buffer.epoch:
                 self.buffer.advance_epoch(epoch)
+            self._advertise()
             return {
                 "version": self.version,
                 "quorum": self.policy.quorum_for(world),
                 "have_global": self.global_leaves is not None,
+                "incarnation": self.incarnation,
             }
 
     def _init(self, worker: str, payload: str) -> dict:
@@ -338,14 +462,46 @@ class AggServer:
             if self.global_leaves is None:
                 self.global_leaves = decode_leaves(payload)
                 print(f"[aggserver] v0 global seeded by {worker!r}", flush=True)
-            return {"version": self.version}
+                # the v0 global must survive a pre-first-commit crash
+                self._persist()
+            self._advertise()
+            return {"version": self.version, "incarnation": self.incarnation}
 
     def _push(self, req: dict) -> dict:
         worker = str(req["worker"])
         codec = str(req.get("codec", "none"))
+        push_id = str(req.get("push_id", "") or "")
         with self._lock:
             if self.global_leaves is None:
                 return {"error": "push before init: no v0 global"}
+            based_on = int(req["based_on"])
+            if based_on > self.version:
+                # a torn persist can restore the authority a commit
+                # behind a worker's adopted version; folding such an
+                # entry would ValueError at quorum time and poison every
+                # pending worker's commit — reject it at the wire and
+                # tell the worker to resync
+                return {
+                    "error": (
+                        f"rebase: push based_on v{based_on} is ahead of "
+                        f"the restored global v{self.version} (authority "
+                        "restarted); re-hello and adopt the current global"
+                    )
+                }
+            if push_id and push_id in self._push_ledger:
+                # idempotent re-delivery of an already-disposed push
+                # (retry after a lost ack, chaos duplication): ack it
+                # again, never re-buffer — the exactly-once half of the
+                # zero-acked-push-loss contract
+                self._dup_pushes += 1
+                self._m_dups.inc()
+                self._advertise()
+                return {
+                    "version": self.version,
+                    "committed": False,
+                    "duplicate": True,
+                    "incarnation": self.incarnation,
+                }
             self._m_push_bytes.inc(
                 float(len(req["payload"])), worker=worker
             )
@@ -366,13 +522,24 @@ class AggServer:
                 worker=worker,
                 round=int(req["round"]),
                 epoch=int(req.get("epoch", self.buffer.epoch)),
-                based_on=int(req["based_on"]),
+                based_on=based_on,
                 weight=float(req.get("weight", 1.0)),
                 arrival_ms=time.monotonic() * 1e3,
                 leaves=leaves,
                 codec=entry_codec,
+                push_id=push_id,
             )
-            self.buffer.add(entry)
+            replaced = self.buffer.add(entry)
+            if replaced is not None and replaced.push_id:
+                if replaced.push_id == push_id:
+                    # the same contribution delivered twice while still
+                    # pending (proxy duplication): one entry remains
+                    self._dup_pushes += 1
+                    self._m_dups.inc()
+                else:
+                    self._ledger_set(
+                        replaced.push_id, "superseded", by=push_id
+                    )
             self._workers.add(worker)
             self._arrival[worker] = entry.arrival_ms
             # start a buffer->commit flow arrow inside this push's serve
@@ -384,7 +551,12 @@ class AggServer:
             committed = self._maybe_commit()
             self._g_pending.set(float(len(self.buffer)))
             self._persist()
-            return {"version": self.version, "committed": committed}
+            self._advertise()
+            return {
+                "version": self.version,
+                "committed": committed,
+                "incarnation": self.incarnation,
+            }
 
     def _decode_push(self, codec: str, payload: str) -> tuple[list, str]:
         """Caller holds the lock.  An encoded push becomes buffer leaves:
@@ -431,6 +603,18 @@ class AggServer:
             return False
         entries = self.buffer.take_all()
         assert self.global_leaves is not None
+        # ledger every folded/dropped push id BEFORE the version bump
+        # (mirrors fold_commit's staleness filter exactly): each acked
+        # push reaches exactly one terminal disposition
+        for e in entries:
+            s = self.version - e.based_on
+            self._ledger_set(
+                e.push_id,
+                "stale_dropped" if s > self.policy.staleness_cap
+                else "folded",
+                version=self.version + 1,
+                staleness=max(s, 0),
+            )
         tracer = get_tracer()
         commit_flow = wireobs.new_span_id()
         fold_t0 = time.perf_counter()
@@ -479,6 +663,7 @@ class AggServer:
                 "late_folds": stats.late_folds,
                 "stale_drops": stats.stale_drops,
                 "mean_staleness": stats.mean_staleness,
+                "max_staleness": stats.max_staleness,
                 "quorum": len(pending),
                 "quorum_wait_ms": wait,
                 "closer": closer,
@@ -490,9 +675,13 @@ class AggServer:
 
     def _global(self, since: int) -> dict:
         with self._lock:
+            self._advertise()
             if self.global_leaves is None:
-                return {"version": -1}
-            out: dict = {"version": self.version}
+                return {"version": -1, "incarnation": self.incarnation}
+            out: dict = {
+                "version": self.version,
+                "incarnation": self.incarnation,
+            }
             if self.version > since:
                 out["payload"] = encode_leaves(self.global_leaves)
                 if (
@@ -508,14 +697,22 @@ class AggServer:
         with self._lock:
             return {
                 "version": self.version,
+                "incarnation": self.incarnation,
                 "pending": len(self.buffer),
                 "pending_workers": sorted(self.buffer.pending_workers()),
+                "pending_push_ids": sorted(
+                    e.push_id for e in self.buffer.entries if e.push_id
+                ),
                 "workers": sorted(self._workers),
                 "epoch": self.buffer.epoch,
                 "commits": list(self.commit_log),
                 "gate_ms": dict(self._gate_ms),
                 "push_bytes": dict(self._push_bytes),
                 "push_counts": dict(self._push_counts),
+                "push_dups": self._dup_pushes,
+                "ledger": {
+                    k: dict(v) for k, v in self._push_ledger.items()
+                },
             }
 
 

@@ -26,11 +26,30 @@ round:
 Because every worker seeds identically (same config, same
 ``train.seed``), the first worker's ``init`` push IS the version-0
 global; the others verify against it by adopting it.
+
+Partition tolerance (ROADMAP 1(c)) rides
+:class:`fedrec_tpu.parallel.rpc.FleetRpc`: every exchange retries
+transport failures inside the ``agg.worker_*`` budgets with full-jitter
+backoff and a per-edge circuit breaker.  When the authority stays
+unreachable the worker DEGRADES instead of crashing — each contribution
+it cannot deliver parks on an unacked list (its client-generated
+``push_id`` is reused verbatim on the retry, so the authority's ledger
+can never fold it twice) and training continues, until the wire has
+been silent longer than ``agg.worker_unreachable_budget_s``; then it
+raises :class:`~fedrec_tpu.parallel.rpc.AuthorityUnreachable` and the
+CLI exits rc-75 for the supervisor.  When the authority RESTARTS the
+worker notices the incarnation bump in any reply, re-hellos, flushes
+the unacked backlog, and adopts the restored committed global
+(``agg.resyncs_total`` counts these) — acked history is never
+re-trained, and a push the restore left behind ("rebase" error reply:
+its base is ahead of the restored global) is dropped in favor of
+adopting the authority's current truth.
 """
 
 from __future__ import annotations
 
 import time
+import zlib
 
 import jax
 import numpy as np
@@ -48,13 +67,17 @@ def run_async_worker(
     trainer,
     server: str,
     worker_id: str,
-    timeout_s: float = 60.0,
-    poll_s: float = 0.2,
-    global_wait_s: float = 20.0,
+    timeout_s: float | None = None,
+    poll_s: float | None = None,
+    global_wait_s: float | None = None,
 ) -> list:
     """Drive ``trainer`` for its configured rounds against the commit
     authority at ``server`` ("HOST:PORT").  Returns the round history
-    (same shape as ``Trainer.run``)."""
+    (same shape as ``Trainer.run``).  The keyword knobs default to the
+    ``agg.worker_*`` config values; explicit arguments win (tests pin
+    tight deadlines without a config round-trip).  Raises
+    :class:`~fedrec_tpu.parallel.rpc.AuthorityUnreachable` when the
+    authority stays dark past ``agg.worker_unreachable_budget_s``."""
     from fedrec_tpu.agg.server import (
         decode_leaves,
         encode_leaves,
@@ -68,11 +91,23 @@ def run_async_worker(
         validate_codec,
     )
     from fedrec_tpu.obs import wire
-    from fedrec_tpu.obs.fleet import request_json_line
+    from fedrec_tpu.parallel.rpc import (
+        AuthorityUnreachable,
+        FleetRpc,
+        RpcPolicy,
+        new_push_id,
+    )
 
     cfg = trainer.cfg
     host, port_s = server.rsplit(":", 1)
     port = int(port_s)
+    if timeout_s is None:
+        timeout_s = float(cfg.agg.worker_timeout_s)
+    if poll_s is None:
+        poll_s = float(cfg.agg.worker_poll_s)
+    if global_wait_s is None:
+        global_wait_s = float(cfg.agg.worker_global_wait_s)
+    unreachable_budget_s = float(cfg.agg.worker_unreachable_budget_s)
     codec = cfg.fed.dcn_compress
     if codec != "none":
         # "auto" never reaches here (the trainer guard pins async to
@@ -85,8 +120,22 @@ def run_async_worker(
     )
     ef_residual: list | None = None   # this edge's banked encode error
 
-    def rpc(req: dict) -> dict:
-        return request_json_line(host, port, req, timeout_s=timeout_s)
+    rpc = FleetRpc(host, port, RpcPolicy(
+        connect_timeout_s=cfg.agg.worker_connect_timeout_s,
+        read_timeout_s=timeout_s,
+        attempts=cfg.agg.worker_rpc_attempts,
+        backoff_base_ms=cfg.agg.worker_backoff_ms,
+        backoff_max_ms=cfg.agg.worker_backoff_cap_ms,
+        # the bounded poll loop IS the retry for `global`; re-dialing
+        # inside one poll tick would double-spend the wait budget
+        op_attempts={"global": 1},
+        # probe a dead authority at least about once per round: an open
+        # breaker makes the round loop fail fast, so the reset window is
+        # what paces recovery detection — cap it at the per-round wait
+        breaker_reset_s=min(10.0, global_wait_s),
+        # decorrelate the fleet's jitter streams without per-worker config
+        seed=zlib.crc32(worker_id.encode()),
+    ))
 
     g_version = trainer.registry.gauge(
         "agg.global_version",
@@ -105,17 +154,137 @@ def run_async_worker(
         "encoded contribution bytes this worker pushed (measured payload "
         "buffers, pre-base64) — the async uplink the codec compresses",
     )
+    c_resyncs = trainer.registry.counter(
+        "agg.resyncs_total",
+        "re-hello/re-adopt cycles after an authority incarnation bump or "
+        "rebase reply (the crash-recovery handshake; 0 when the authority "
+        "never restarted)",
+    )
 
     epoch = 0
-    hello = rpc({"cmd": "hello", "worker": worker_id, "epoch": epoch})
-    version = int(hello["version"])
-    leaves, treedef = _flatten_params(trainer)
-    if not hello.get("have_global"):
-        rpc({
-            "cmd": "init", "worker": worker_id,
-            "payload": encode_leaves(leaves),
-        })
-    resp = rpc({"cmd": "global", "since": -1})
+    incarnation: int | None = None
+    # contributions the wire failed to deliver: each req keeps its
+    # push_id, so the eventual retry is idempotent at the authority
+    unacked: list[dict] = []
+    version = 0
+    base: list[np.ndarray] = []
+    treedef = None
+
+    def note_incarnation(resp: dict) -> bool:
+        """Adopt the authority's advertised incarnation; True when it
+        BUMPED (the authority restarted since our last exchange)."""
+        nonlocal incarnation
+        adv = resp.get("incarnation")
+        if adv is None:
+            return False
+        adv = int(adv)
+        bumped = incarnation is not None and adv != incarnation
+        incarnation = adv
+        return bumped
+
+    def check_budget(cause: Exception | None = None) -> None:
+        silent = rpc.unreachable_for()
+        if silent > unreachable_budget_s:
+            raise AuthorityUnreachable(
+                f"commit authority {rpc.peer} unreachable for "
+                f"{silent:.0f}s (budget agg.worker_unreachable_budget_s="
+                f"{unreachable_budget_s:g}s, {len(unacked)} unacked "
+                "pushes parked) — exiting rc-75 for the supervisor"
+            ) from cause
+
+    def flush_unacked() -> bool:
+        """Re-deliver parked pushes in arrival order; stops at the first
+        transport failure (the wire is still down — keep them parked).
+        True when any reply advertised a BUMPED incarnation (the
+        authority restarted: the round loop should resync; the resync
+        path itself ignores the return — it is already the handshake)."""
+        bumped = False
+        while unacked:
+            req = unacked[0]
+            try:
+                resp = rpc.call(req, op="push")
+            except OSError as e:
+                check_budget(e)
+                return bumped
+            except ValueError:
+                # the authority answered and refused (restored global is
+                # behind this push's base, or the entry can no longer
+                # fold) — this contribution is unfoldable, drop it
+                print(
+                    f"[agg-worker {worker_id}] dropping unacked push "
+                    f"{req.get('push_id', '?')} (authority refused it "
+                    "after restart)",
+                    flush=True,
+                )
+                unacked.pop(0)
+                continue
+            unacked.pop(0)
+            bumped = note_incarnation(resp) or bumped
+            if resp.get("duplicate"):
+                print(
+                    f"[agg-worker {worker_id}] push "
+                    f"{req.get('push_id', '?')} was already folded "
+                    "(idempotent retry)",
+                    flush=True,
+                )
+        return bumped
+
+    def resync(reason: str) -> bool:
+        """The crash-recovery handshake: re-hello, flush the unacked
+        backlog, adopt the authority's current committed global.  True
+        when a global was adopted (the round loop must not clobber
+        ``base`` afterwards).  Best-effort on a dead wire — the degrade
+        budget is the backstop."""
+        nonlocal version, base
+        c_resyncs.inc()
+        print(
+            f"[agg-worker {worker_id}] resyncing with {rpc.peer} "
+            f"({reason})",
+            flush=True,
+        )
+        try:
+            hello = rpc.call(
+                {"cmd": "hello", "worker": worker_id, "epoch": epoch},
+                op="hello",
+            )
+            note_incarnation(hello)
+            flush_unacked()
+            resp = rpc.call({"cmd": "global", "since": -1}, op="global")
+        except OSError as e:
+            check_budget(e)
+            return False
+        note_incarnation(resp)
+        if "payload" in resp:
+            base = decode_leaves(resp["payload"])
+            version = int(resp["version"])
+            _adopt(trainer, treedef, base)
+            g_version.set(float(version))
+            return True
+        return False
+
+    # ----------------------------------------------------------- bootstrap
+    # without a hello + a version-0 global there is nothing to train
+    # against, so bootstrap failures are immediately rc-75 material — the
+    # supervisor respawns us against a (re)started authority
+    try:
+        hello = rpc.call(
+            {"cmd": "hello", "worker": worker_id, "epoch": epoch}, op="hello"
+        )
+        note_incarnation(hello)
+        version = int(hello["version"])
+        leaves, treedef = _flatten_params(trainer)
+        if not hello.get("have_global"):
+            rpc.call({
+                "cmd": "init", "worker": worker_id,
+                "payload": encode_leaves(leaves),
+            }, op="init")
+        resp = rpc.call({"cmd": "global", "since": -1}, op="global")
+    except OSError as e:
+        raise AuthorityUnreachable(
+            f"commit authority {rpc.peer} unreachable during bootstrap "
+            f"({e}) — exiting rc-75 for the supervisor"
+        ) from e
+    note_incarnation(resp)
     if "payload" in resp:
         base = decode_leaves(resp["payload"])
         version = int(resp["version"])
@@ -137,6 +306,7 @@ def run_async_worker(
         history.append(result)
         trainer._after_round(result)
 
+        adopted_this_round = False
         after, _ = _flatten_params(trainer)
         delta = [a - b for a, b in zip(after, base)]
         if codec == "none":
@@ -166,6 +336,16 @@ def run_async_worker(
                 ]
             wire_payload = encode_payloads(payloads)
             c_uplink.inc(float(sum(payload_nbytes(p) for p in payloads)))
+        # the push request captures based_on NOW — the version this
+        # round's delta was actually computed against — because the
+        # backlog flush below can resync and advance `version` under us
+        push_req = {
+            "cmd": "push", "worker": worker_id, "round": round_idx,
+            "epoch": epoch, "based_on": version, "weight": 1.0,
+            "payload": wire_payload, "codec": codec,
+            # generated once per contribution; a retry reuses it verbatim
+            "push_id": new_push_id(worker_id, round_idx),
+        }
         if straggle_s > 0:
             print(
                 f"[agg-worker {worker_id}] straggling "
@@ -173,22 +353,71 @@ def run_async_worker(
                 flush=True,
             )
             time.sleep(straggle_s)
+
+        # any backlog first (arrival order), so a recovered wire folds
+        # contributions oldest-first and this round's push lands last;
+        # a bump seen here means the authority restarted while we were
+        # degraded — run the recovery handshake before the fresh push
+        if unacked and flush_unacked():
+            adopted_this_round = resync("incarnation bump") \
+                or adopted_this_round
         with trainer.tracer.span("agg.push", round=round_idx,
                                  based_on=version):
-            resp = rpc({
-                "cmd": "push", "worker": worker_id, "round": round_idx,
-                "epoch": epoch, "based_on": version, "weight": 1.0,
-                "payload": wire_payload, "codec": codec,
-            })
-        c_pushes.inc()
-        g_staleness.set(float(max(0, int(resp["version"]) - version)))
+            try:
+                resp = rpc.call(push_req, op="push")
+            except OSError as e:
+                # the wire is down: park the contribution (same push_id
+                # on the eventual retry) and keep training degraded
+                unacked.append(push_req)
+                print(
+                    f"[agg-worker {worker_id}] authority unreachable for "
+                    f"round-{round_idx} push ({e.__class__.__name__}); "
+                    f"parked ({len(unacked)} unacked), training on",
+                    flush=True,
+                )
+                check_budget(e)
+                resp = None
+            except ValueError as e:
+                if "rebase" in str(e) or "ahead of" in str(e):
+                    # the authority restarted BEHIND us: our base version
+                    # no longer exists, so this delta is unfoldable —
+                    # drop it and adopt the restored global
+                    print(
+                        f"[agg-worker {worker_id}] round-{round_idx} push "
+                        f"refused ({e}); dropping it and resyncing",
+                        flush=True,
+                    )
+                    adopted_this_round = resync("rebase reply")
+                    resp = None
+                else:
+                    raise
+        if resp is not None:
+            c_pushes.inc()
+            g_staleness.set(float(max(0, int(resp["version"]) - version)))
+            if note_incarnation(resp):
+                # the restarted authority ACCEPTED this push; re-hello
+                # and adopt its restored global before the next round
+                adopted_this_round = resync("incarnation bump") \
+                    or adopted_this_round
 
         # bounded wait for a commit NEWER than our base; timing out is
         # the async contract (train on, push staler next round)
         deadline = time.monotonic() + global_wait_s
         new_version, payload, commit_flow = version, None, None
         while time.monotonic() < deadline:
-            resp = rpc({"cmd": "global", "since": version})
+            try:
+                resp = rpc.call(
+                    {"cmd": "global", "since": version}, op="global"
+                )
+            except OSError as e:
+                # a dead wire makes the poll pointless — proceed stale
+                # now, the next round's flush/push probes recovery
+                check_budget(e)
+                break
+            if note_incarnation(resp):
+                adopted_this_round = resync("incarnation bump") \
+                    or adopted_this_round
+                break
             if "payload" in resp:
                 new_version, payload = int(resp["version"]), resp["payload"]
                 # the commit's flow id rides the reply ENVELOPE: finish
@@ -207,7 +436,7 @@ def run_async_worker(
                 version = new_version
                 _adopt(trainer, treedef, base)
             g_version.set(float(version))
-        else:
+        elif not adopted_this_round:
             base = after
             print(
                 f"[agg-worker {worker_id}] no commit within "
@@ -216,18 +445,36 @@ def run_async_worker(
                 flush=True,
             )
 
-    # the run()-loop's exit-path bookkeeping: artifacts + final push
-    if trainer._obs_dir is not None:
-        try:
-            from fedrec_tpu.obs import dump_artifacts
-
-            dump_artifacts(
-                trainer._obs_dir, registry=trainer.registry,
-                tracer=trainer.tracer,
+    # one last delivery attempt for anything still parked — after this
+    # the contribution is gone with the process, so say so
+    if unacked:
+        flush_unacked()
+        if unacked:
+            print(
+                f"[agg-worker {worker_id}] exiting with {len(unacked)} "
+                "undelivered pushes (authority still unreachable)",
+                flush=True,
             )
-        except OSError as e:
-            print(f"[agg-worker {worker_id}] could not write obs "
-                  f"artifacts: {e}", flush=True)
+
+    # the run()-loop's exit-path bookkeeping: artifacts + final push.
+    # One bounded retry each — the exit path is the last chance to bank
+    # the round history, so a transient FS/wire hiccup gets a second try
+    if trainer._obs_dir is not None:
+        from fedrec_tpu.obs import dump_artifacts
+
+        for attempt in (0, 1):
+            try:
+                dump_artifacts(
+                    trainer._obs_dir, registry=trainer.registry,
+                    tracer=trainer.tracer,
+                )
+                break
+            except OSError as e:
+                if attempt == 0:
+                    time.sleep(0.5)
+                    continue
+                print(f"[agg-worker {worker_id}] could not write obs "
+                      f"artifacts: {e}", flush=True)
     if trainer.fleet_pusher is not None:
         trainer.fleet_pusher.push(final=True)
     try:

@@ -172,8 +172,49 @@ def main(argv: list[str] | None = None) -> int:
         trainer = Trainer(cfg, data, token_states)
 
         from fedrec_tpu.agg.worker import run_async_worker
+        from fedrec_tpu.parallel.rpc import AuthorityUnreachable
 
-        history = run_async_worker(trainer, args.agg_server, args.worker_id)
+        # wire-level fault injection: a seeded chaos TCP proxy fronts
+        # the authority and this worker dials THROUGH it, so torn
+        # connections / duplicated pushes / partitions exercise the
+        # resilient-RPC path on a real socket (scripts/async_smoke.sh's
+        # fault leg). With the spec empty no proxy is built at all.
+        proxy = None
+        if cfg.chaos.wire_faults:
+            if not cfg.chaos.enabled:
+                raise ValueError(
+                    "wire fault injection requires chaos.enabled=true "
+                    "(chaos.wire_faults is part of the chaos plan)"
+                )
+            from fedrec_tpu.fed.chaos import ChaosProxy, WireFaultPlan
+
+            up_host, up_port = args.agg_server.rsplit(":", 1)
+            proxy = ChaosProxy(
+                up_host, int(up_port),
+                plan=WireFaultPlan(
+                    cfg.chaos.wire_faults, seed=cfg.chaos.wire_seed
+                ),
+            )
+            proxy.start()
+            print(
+                f"[run] chaos wire proxy {proxy.address} -> "
+                f"{args.agg_server} ({cfg.chaos.wire_faults})",
+                file=sys.stderr,
+            )
+        try:
+            history = run_async_worker(
+                trainer,
+                proxy.address if proxy is not None else args.agg_server,
+                args.worker_id,
+            )
+        except AuthorityUnreachable as e:
+            # degrade, don't crash: rc-75 tells the PR-5 supervisor to
+            # respawn this worker against the (re)started authority
+            print(f"[run] {e}", file=sys.stderr)
+            return e.returncode
+        finally:
+            if proxy is not None:
+                proxy.stop()
     else:
         trainer = Trainer(cfg, data, token_states)
         history = trainer.run()

@@ -795,6 +795,24 @@ class ChaosConfig:
     # epoch — without it a fast respawn can race straight back into the
     # formation window and the shrink never becomes observable. 0 = off.
     rejoin_delay_s: float = 0.0
+    # ---- wire-level fault injection (fed.chaos.ChaosProxy): a seeded
+    # TCP chaos proxy fronting the commit authority (or membership
+    # service) applies time-windowed transport faults per connection.
+    # wire_faults is a comma list of "kind@start[-end][:arg]" specs,
+    # start/end in seconds since proxy start, "*" = always:
+    #
+    #     drop@5-10          refuse/black-hole connections in [5s, 10s)
+    #     drop@*:0.3         drop 30% of connections, always
+    #     delay@0-60:250     add 250ms before forwarding the request
+    #     tear@5-10          forward HALF the request bytes, then RST
+    #     dup@5-10           deliver the request TWICE upstream
+    #     partition@20-30    full partition: nothing gets through
+    #
+    # Faults are drawn from a PRNG seeded per (wire_seed, connection
+    # index), so a soak's fault schedule replays bit-identically; with
+    # wire_faults empty the proxy forwards every byte verbatim (pinned).
+    wire_faults: str = ""
+    wire_seed: int = 0
 
 
 @dataclass
@@ -840,6 +858,22 @@ class AggConfig:
     quorum: int = 0                    # async commit quorum K; 0 = all-reporting
     staleness_cap: int = 2             # drop buffered updates older than this (commits)
     tree_fanout: int = 2               # hierarchical tier width (>= 2)
+    # ---- async worker wire policy (agg/worker.py + parallel/rpc.py):
+    # the failure-handling budgets one worker<->authority edge runs
+    # under. Exchanges retry transport failures with full-jitter
+    # exponential backoff inside worker_rpc_attempts; a dead host fails
+    # in worker_connect_timeout_s (the dial budget) while a slow fold
+    # still gets worker_timeout_s on the established socket. When the
+    # wire stays silent past worker_unreachable_budget_s the worker
+    # stops degrading and exits rc-75 for the supervisor to respawn.
+    worker_timeout_s: float = 60.0     # per-exchange read/socket deadline
+    worker_connect_timeout_s: float = 5.0   # dial budget (dead host fails fast)
+    worker_poll_s: float = 0.2         # sleep between commit-poll ticks
+    worker_global_wait_s: float = 20.0  # bounded wait for a newer commit per round
+    worker_rpc_attempts: int = 4       # per-op transport retry budget
+    worker_backoff_ms: float = 50.0    # full-jitter backoff base
+    worker_backoff_cap_ms: float = 2000.0   # backoff ceiling per retry
+    worker_unreachable_budget_s: float = 120.0  # wire silence before rc-75 degrade
 
 
 @dataclass

@@ -31,10 +31,25 @@ Client-side faults come in two flavors:
 Host-level faults (``kill_round``/``kill_process``, guarded by an
 on-disk marker so a resumed world doesn't re-die; ``torn_snapshot_round``)
 live in the coordinator CLI, which reads the same config section.
+
+**Wire-level faults** (``chaos.wire_faults`` + ``chaos.wire_seed``)
+exercise the TRANSPORT instead of the update math: a seeded
+:class:`WireFaultPlan` drives a :class:`ChaosProxy` — a TCP
+man-in-the-middle fronting the commit authority or membership service —
+that drops, delays, tears mid-message, duplicates, or fully partitions
+the one-shot JSON-lines exchanges passing through it, per connection and
+per time window.  Fault draws are pure in ``(wire_seed, connection
+index)``, so a churn soak's fault schedule replays bit-identically; with
+no plan (or outside every window) the proxy forwards every byte
+VERBATIM — the passthrough is pinned byte-identical in
+``tests/test_rpc.py``, so chaos-off runs cannot differ by construction.
 """
 
 from __future__ import annotations
 
+import socket
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -249,3 +264,259 @@ def population_report(
             # reports faster, the heavy tail is what deadlines cut
             latency[i] = straggle_ms * rng.lognormal(0.0, straggle_sigma)
     return dropped, latency
+
+
+# ======================================================================
+# wire-level fault injection (chaos.wire_faults): seeded network faults
+# applied by a chaos TCP proxy fronting a JSON-lines service
+# ======================================================================
+
+# transport fault kinds and their default argument (probability for
+# drop, milliseconds for delay, copies for dup; tear/partition take none)
+WIRE_FAULT_KINDS = {
+    "drop": 1.0,        # refuse the connection (arg = probability)
+    "delay": 100.0,     # hold the request this many ms before forwarding
+    "tear": 0.0,        # forward HALF the request bytes, then hang up
+    "dup": 2.0,         # deliver the request arg times upstream
+    "partition": 0.0,   # full partition: nothing gets through the window
+}
+
+
+def parse_wire_faults(spec: str) -> list[tuple[str, float, float, float]]:
+    """Parse the ``chaos.wire_faults`` DSL: comma list of
+    ``kind@start[-end][:arg]`` — ``start``/``end`` are seconds since the
+    proxy started, ``*`` means always, a single time ``t`` means the
+    one-second window ``[t, t+1)``.  Returns ``(kind, start_s, end_s,
+    arg)`` tuples; raises ``ValueError`` on malformed entries so a
+    typo'd plan fails at build time, not silently fault-free."""
+    out: list[tuple[str, float, float, float]] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        try:
+            kind, rest = item.split("@", 1)
+            arg_s = None
+            if ":" in rest:
+                rest, arg_s = rest.split(":", 1)
+            if rest == "*":
+                start, end = 0.0, float("inf")
+            elif "-" in rest:
+                start_s, end_s = rest.split("-", 1)
+                start, end = float(start_s), float(end_s)
+            else:
+                start = float(rest)
+                end = start + 1.0
+            arg = (
+                float(arg_s) if arg_s is not None
+                else WIRE_FAULT_KINDS.get(kind, 0.0)
+            )
+        except ValueError:
+            raise ValueError(
+                f"chaos.wire_faults entry {item!r} is not "
+                "'kind@start[-end][:arg]' (e.g. 'tear@2-4,dup@5-8,"
+                "partition@20-30,drop@*:0.3')"
+            ) from None
+        if kind not in WIRE_FAULT_KINDS:
+            raise ValueError(
+                f"chaos.wire_faults entry {item!r}: unknown kind {kind!r}; "
+                f"expected one of {sorted(WIRE_FAULT_KINDS)}"
+            )
+        if end <= start:
+            raise ValueError(
+                f"chaos.wire_faults entry {item!r}: empty window "
+                f"[{start:g}, {end:g})"
+            )
+        out.append((kind, start, end, arg))
+    return out
+
+
+class WireFaultPlan:
+    """Seeded, deterministic wire-fault schedule for one proxy.
+
+    ``actions(t_s, conn_idx)`` resolves which faults apply to the
+    ``conn_idx``-th accepted connection at ``t_s`` seconds since proxy
+    start.  Probabilistic draws (``drop`` with ``arg < 1``) come from
+    ``default_rng([seed, conn_idx])`` — pure in the inputs, so the same
+    soak re-runs against the identical fault schedule."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = str(spec)
+        self.seed = int(seed)
+        self.entries = parse_wire_faults(self.spec)
+
+    def actions(self, t_s: float, conn_idx: int) -> list[tuple[str, float]]:
+        out: list[tuple[str, float]] = []
+        rng = None
+        for kind, start, end, arg in self.entries:
+            if not start <= t_s < end:
+                continue
+            if kind == "drop" and arg < 1.0:
+                if rng is None:
+                    rng = np.random.default_rng([self.seed, int(conn_idx)])
+                if rng.random() >= arg:
+                    continue
+            out.append((kind, arg))
+        return out
+
+
+class ChaosProxy:
+    """A chaos TCP man-in-the-middle for one-shot JSON-lines exchanges.
+
+    Listens on ``address`` and forwards each accepted connection's
+    single request line to ``upstream``, then the reply line back —
+    BYTE-VERBATIM when no fault applies (pinned in tests/test_rpc.py:
+    chaos off can never change the wire).  When the plan fires:
+
+    * ``partition`` / ``drop`` — the client's connection is closed
+      before any byte crosses (a black-holed edge),
+    * ``delay`` — the request is held ``arg`` ms before forwarding,
+    * ``tear`` — HALF the request bytes reach the upstream, then both
+      sides are hung up (the torn-mid-message case the push ledger and
+      same-(worker, round) replacement must absorb),
+    * ``dup`` — the request is delivered ``arg`` times as separate
+      upstream exchanges; the client gets the FIRST reply (duplicated
+      delivery after a lost ack — the idempotent ``push_id`` case).
+
+    Faults count into ``chaos.wire_faults_total`` (labelled by kind) and
+    the local ``injected`` dict for artifact banking."""
+
+    _POLL_S = 0.2
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        plan: WireFaultPlan | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        timeout_s: float = 30.0,
+    ):
+        self.upstream = (str(upstream_host), int(upstream_port))
+        self.plan = plan
+        self.timeout_s = float(timeout_s)
+        self.injected: dict[str, int] = {}
+        self._sock = socket.create_server((host, int(port)))
+        self._sock.settimeout(self._POLL_S)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._conn_idx = 0
+        self._t0 = time.monotonic()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ChaosProxy":
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="chaos-proxy", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- plumbing
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        from fedrec_tpu.obs import get_registry
+
+        get_registry().counter(
+            "chaos.wire_faults_total",
+            "transport faults the chaos proxy injected, by kind "
+            "(seeded plan: chaos.wire_faults / chaos.wire_seed)",
+            labels=("kind",),
+        ).inc(kind=kind)
+
+    @staticmethod
+    def _read_line(conn: socket.socket) -> bytes:
+        """The full request (through its newline) as raw bytes."""
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(1 << 20)
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+
+    def _exchange_upstream(self, payload: bytes) -> bytes:
+        with socket.create_connection(
+            self.upstream, timeout=self.timeout_s
+        ) as up:
+            up.settimeout(self.timeout_s)
+            up.sendall(payload)
+            return self._read_line(up)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            idx, self._conn_idx = self._conn_idx, self._conn_idx + 1
+            t_s = time.monotonic() - self._t0
+            threading.Thread(
+                target=self._handle, args=(conn, idx, t_s), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket, idx: int, t_s: float) -> None:
+        actions = (
+            dict(self.plan.actions(t_s, idx)) if self.plan is not None else {}
+        )
+        try:
+            with conn:
+                conn.settimeout(self.timeout_s)
+                if "partition" in actions or "drop" in actions:
+                    # black hole: the client sees a reset/empty reply and
+                    # its resilient RPC retries into the backoff budget
+                    self._count(
+                        "partition" if "partition" in actions else "drop"
+                    )
+                    return
+                payload = self._read_line(conn)
+                if not payload:
+                    return
+                if "delay" in actions:
+                    self._count("delay")
+                    time.sleep(actions["delay"] / 1e3)
+                if "tear" in actions:
+                    # half the request reaches the peer, then both sides
+                    # hang up: the peer sees no full line (sends nothing),
+                    # the client sees an ack-less close (OSError)
+                    self._count("tear")
+                    try:
+                        with socket.create_connection(
+                            self.upstream, timeout=self.timeout_s
+                        ) as up:
+                            up.sendall(payload[: max(len(payload) // 2, 1)])
+                    except OSError:
+                        pass
+                    return
+                copies = int(actions.get("dup", 1)) if "dup" in actions else 1
+                if copies > 1:
+                    self._count("dup")
+                reply = b""
+                for i in range(max(copies, 1)):
+                    try:
+                        got = self._exchange_upstream(payload)
+                    except OSError:
+                        got = b""
+                    if i == 0:
+                        reply = got
+                if reply:
+                    conn.sendall(reply)
+        except OSError:
+            pass

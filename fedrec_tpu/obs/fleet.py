@@ -239,7 +239,8 @@ class TelemetryCollector:
 
 
 def request_json_line(
-    host: str, port: int, req: dict, timeout_s: float, op: str | None = None
+    host: str, port: int, req: dict, timeout_s: float, op: str | None = None,
+    connect_timeout_s: float | None = None,
 ) -> dict:
     """THE client half of the one-shot JSON-lines exchange: connect,
     send one request line, read one response line.  Raises ``OSError``
@@ -248,6 +249,11 @@ def request_json_line(
     or ``{"error": ...}`` reply.  Shared by :class:`FleetPusher`,
     ``MembershipClient`` and the async agg worker so the client wire
     protocol cannot drift.
+
+    ``connect_timeout_s`` splits the dial deadline from the exchange
+    deadline (``timeout_s``): a dead host should fail in connect time,
+    while a live peer mid-fold gets the full read budget.  ``None``
+    keeps the historical single-deadline behavior.
 
     Wire observability (:mod:`fedrec_tpu.obs.wire`, default on): the
     request carries an additive trace-context envelope, the reply's
@@ -264,8 +270,10 @@ def request_json_line(
         req = {**req, wire.WIRE_KEY: req_env}
     line = (json.dumps(req) + "\n").encode()
     t0 = time.perf_counter()
+    dial_s = timeout_s if connect_timeout_s is None else connect_timeout_s
     try:
-        with socket.create_connection((host, port), timeout=timeout_s) as conn:
+        with socket.create_connection((host, port), timeout=dial_s) as conn:
+            conn.settimeout(timeout_s)
             conn.sendall(line)
             buf = b""
             while b"\n" not in buf:
@@ -420,13 +428,16 @@ class FleetPusher:
     failures, round-cadence pushes are SKIPPED for an exponentially
     growing window (a packet-dropping collector would otherwise stall
     every round by the full connect timeout); ``final=True`` pushes
-    always try — they are once-per-run and bounded.  Identity
+    always try — they are once-per-run and bounded — and get one
+    bounded retry, since a single transient failure there would lose
+    the last round's telemetry outright.  Identity
     (worker/rank/epoch) is read from :func:`get_fleet_identity` at push
     time unless given."""
 
     _BACKOFF_AFTER = 3          # consecutive failures before skipping
     _BACKOFF_BASE_S = 30.0
     _BACKOFF_MAX_S = 600.0
+    _FINAL_RETRY_DELAY_S = 1.0  # the final push's single bounded retry
 
     def __init__(
         self,
@@ -494,12 +505,24 @@ class FleetPusher:
             "alerts": alerts,
             "final": bool(final),
         }
-        try:
-            request_json_line(self.host, self.port, req, self.timeout_s)
-        except (OSError, ValueError):
-            self.failures += 1
-            self._consec_failures += 1
-            self._m_failures.inc()
+        # a FINAL push is once-per-run — its failure loses the last
+        # round's telemetry outright, so it gets one bounded retry where
+        # round-cadence pushes (a later round will re-carry the snapshot)
+        # stay single-attempt
+        attempts = 2 if final else 1
+        delivered = False
+        for attempt in range(attempts):
+            try:
+                request_json_line(self.host, self.port, req, self.timeout_s)
+                delivered = True
+                break
+            except (OSError, ValueError):
+                self.failures += 1
+                self._consec_failures += 1
+                self._m_failures.inc()
+                if attempt + 1 < attempts:
+                    time.sleep(self._FINAL_RETRY_DELAY_S)
+        if not delivered:
             if self._consec_failures >= self._BACKOFF_AFTER:
                 delay = min(
                     self._BACKOFF_BASE_S

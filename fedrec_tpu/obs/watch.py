@@ -588,7 +588,15 @@ class FleetRules:
       (``agg.adopted_version``) stops advancing for
       ``fleet_stalled_pushes`` pushes while its rounds keep completing
       (commit authority dead or unreachable; only armed once a commit
-      was ever adopted, so sync runs never match).
+      was ever adopted, so sync runs never match);
+    * **partitioned edge** — a worker's per-peer ``wire.errors_total``
+      keeps growing across ``fleet_stalled_pushes`` pushes while its
+      ``wire.requests_total`` to the SAME peer does not: every exchange
+      on that edge is failing, which separates a network partition (the
+      worker is alive and pushing telemetry through a different edge)
+      from a dead worker (no pushes at all — the straggler/world rules'
+      territory).  The alert NAMES the edge: worker, peer, and the
+      error count the window accumulated.
 
     Alert records land in ``<collector dir>/worker_fleet/metrics.jsonl``
     — the same worker-dir layout every fleet reader already consumes, so
@@ -638,6 +646,10 @@ class FleetRules:
         self._stalled: dict[str, int] = {}
         self._quorum: deque[float] = deque(maxlen=self._QUORUM_WINDOW)
         self._world_was_full = False
+        # per-(worker, peer) wire cursors for the partitioned-edge rule
+        self._edge_err: dict[tuple[str, str], float] = {}
+        self._edge_req: dict[tuple[str, str], float] = {}
+        self._edge_stall: dict[tuple[str, str], int] = {}
 
     # ------------------------------------------------------------- helpers
     @staticmethod
@@ -645,6 +657,20 @@ class FleetRules:
         from fedrec_tpu.obs.report import snapshot_value
 
         return snapshot_value(snap, name)
+
+    @staticmethod
+    def _edge_totals(snap: dict, name: str) -> dict[str, float]:
+        """Per-peer totals of a peer-labelled wire counter (ops summed
+        away) out of one snapshot."""
+        totals: dict[str, float] = {}
+        rows = snap.get("metrics", {}).get(name, {}).get("values", [])
+        for row in rows:
+            peer = (row.get("labels") or {}).get("peer")
+            if peer:
+                totals[str(peer)] = (
+                    totals.get(str(peer), 0.0) + float(row.get("value", 0.0))
+                )
+        return totals
 
     @staticmethod
     def _round_cell(snap: dict) -> tuple[float, float] | None:
@@ -785,6 +811,39 @@ class FleetRules:
                     ),
                     labels={"worker": wid},
                     value=version,
+                )
+        # ---- partitioned edge: per-peer wire errors grow while requests
+        # to the same peer do not — the edge is black-holed, and because
+        # this telemetry push itself arrived, the WORKER is alive: a
+        # partition, not a death. The alert names the edge.
+        errs = self._edge_totals(snapshot, "wire.errors_total")
+        if errs:
+            reqs = self._edge_totals(snapshot, "wire.requests_total")
+            for peer, err_total in errs.items():
+                ek = (wid, peer)
+                prev_err = self._edge_err.get(ek)
+                prev_req = self._edge_req.get(ek, 0.0)
+                req_total = reqs.get(peer, 0.0)
+                self._edge_err[ek] = err_total
+                self._edge_req[ek] = req_total
+                if prev_err is None:
+                    continue
+                if err_total > prev_err and req_total <= prev_req:
+                    self._edge_stall[ek] = self._edge_stall.get(ek, 0) + 1
+                else:
+                    self._edge_stall[ek] = 0
+                stalled = self._edge_stall[ek]
+                self.engine.observe(
+                    f"fleet:partition:{wid}->{peer}",
+                    stalled >= self.stalled_pushes,
+                    severity="critical",
+                    summary=(
+                        f"partitioned edge: worker {wid} -> {peer} — wire "
+                        f"errors at {err_total:g} and growing with no "
+                        f"completed request for {stalled} pushes"
+                    ),
+                    labels={"worker": wid, "peer": peer},
+                    value=err_total,
                 )
 
 

@@ -1,0 +1,344 @@
+"""Resilient fleet RPC — the retry/deadline/circuit-breaker front for the
+JSON-lines wire.
+
+Every cross-process service in the package (commit authority, membership,
+telemetry collector, serving admin) speaks the one-shot JSON-lines
+exchange of :func:`fedrec_tpu.obs.fleet.request_json_line`.  That helper
+is deliberately a SINGLE attempt: it raises ``OSError`` on any transport
+failure.  At churn scale a single attempt is the wrong contract — a 100
+worker fleet sees torn connections, authority restarts and transient
+partitions as the steady state, and ROADMAP item 1(c) requires workers to
+ride them out.  This module is the one place the failure-handling policy
+lives, absorbing the backoff idiom ``serving/client.py`` pioneered so the
+two wire clients cannot drift:
+
+* :func:`backoff_delay_s` — full-jitter exponential backoff (AWS-style):
+  ``U(0, min(cap, base * 2^attempt))``.  The jitter matters as much as the
+  exponent: a restarted authority must not meet every worker's retry in
+  one synchronized stampede.
+* :class:`RpcPolicy` — split connect/read timeouts (a dead host fails in
+  ``connect_timeout_s``, a slow fold gets the full ``read_timeout_s``),
+  a per-op retry budget (``op_attempts`` overrides ``attempts``) and the
+  backoff shape, as one value object built from ``agg.worker_*`` knobs.
+* :class:`CircuitBreaker` — after ``threshold`` consecutive transport
+  failures the edge "opens": calls fail fast (no connect timeout burned)
+  until ``reset_s`` passes, then a single half-open probe decides whether
+  to close again.  Keeps a worker's round loop training at full speed
+  while the authority is gone instead of stalling every round on the
+  full retry budget.
+* :class:`FleetRpc` — one edge's retrying client over
+  ``request_json_line``.  Transport failures (``OSError``) are retried
+  inside the budget; application error replies (``ValueError``) are NOT —
+  the peer is alive and answered, retrying would re-ask the same bad
+  question.  ``last_ok``/:meth:`FleetRpc.unreachable_for` feed the caller's
+  degrade decision: an async worker keeps training within
+  ``agg.worker_unreachable_budget_s`` of wire silence, then raises
+  :class:`AuthorityUnreachable` and exits :data:`RC_DEGRADED` (rc-75, the
+  PR-5 supervisor's retryable code) instead of crashing.
+* :func:`new_push_id` — the client-generated idempotency token a push
+  carries: retries of the SAME contribution reuse the id, the authority's
+  ledger folds it at most once (``AggBuffer``'s same-(worker, round)
+  replacement already made retries weight-safe; the id makes re-delivery
+  after a commit safe too).
+
+Per-edge accounting rides the shared wire metrics:
+``wire.retries_total`` (re-attempts after a transport failure),
+``wire.circuit_open_total`` (closed->open transitions) and
+``wire.circuit_state`` (0 closed / 1 half-open / 2 open), all labelled by
+peer — docs/OPERATIONS.md §3h reads them back during an incident.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+
+__all__ = [
+    "RC_DEGRADED",
+    "AuthorityUnreachable",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "FleetRpc",
+    "RpcPolicy",
+    "backoff_delay_s",
+    "new_push_id",
+]
+
+# the PR-5 supervisor's retryable exit code: a worker that degrades out of
+# its unreachable budget exits with this so the supervisor respawns it
+# (against the restarted authority) instead of counting a crash
+RC_DEGRADED = 75
+
+
+class AuthorityUnreachable(RuntimeError):
+    """The wire stayed dead past the caller's staleness budget: training
+    on would accumulate unfoldable staleness, so the worker should exit
+    ``RC_DEGRADED`` for the supervisor to respawn."""
+
+    returncode = RC_DEGRADED
+
+
+class CircuitOpen(OSError):
+    """Fail-fast refusal while the edge's circuit breaker is open — an
+    ``OSError`` so every retry/degrade path treats it as the transport
+    failure it stands in for (without burning a connect timeout)."""
+
+
+def backoff_delay_s(
+    attempt: int,
+    base_ms: float = 50.0,
+    max_ms: float = 2000.0,
+    rng: random.Random | None = None,
+) -> float:
+    """Full-jitter exponential backoff (AWS-style): a delay drawn from
+    ``U(0, min(max_ms, base_ms * 2^attempt))``, in seconds.  Shared by
+    :class:`FleetRpc` and ``serving.client.ServingClient`` so the two
+    wire clients' retry shapes cannot drift."""
+    cap = min(float(max_ms), float(base_ms) * (2.0 ** max(int(attempt), 0)))
+    u = rng.uniform(0.0, cap) if rng is not None else random.uniform(0.0, cap)
+    return u / 1e3
+
+
+def new_push_id(worker: str, round_idx: int) -> str:
+    """A client-generated idempotency token for one contribution push.
+    Generated ONCE per (worker, round) contribution and reused verbatim
+    on every retry — the authority's push ledger guarantees a given id
+    folds at most once, so duplicated delivery (retry after a lost ack,
+    chaos duplication) can never double a worker's weight."""
+    return f"{worker}:{int(round_idx)}:{uuid.uuid4().hex[:12]}"
+
+
+@dataclass
+class RpcPolicy:
+    """One edge's failure-handling shape (``agg.worker_*`` in config)."""
+
+    connect_timeout_s: float = 5.0    # dial budget (dead host fails fast)
+    read_timeout_s: float = 60.0      # per-exchange socket deadline
+    attempts: int = 4                 # default per-op attempt budget
+    backoff_base_ms: float = 50.0
+    backoff_max_ms: float = 2000.0
+    # per-op overrides of `attempts` — e.g. a bounded poll loop retries
+    # itself, so `global` can run a leaner budget than `push`
+    op_attempts: dict = field(default_factory=dict)
+    breaker_threshold: int = 5        # consecutive failures before opening
+    breaker_reset_s: float = 10.0     # open -> half-open probe interval
+    seed: int | None = None           # jitter stream (decorrelate workers)
+
+    def attempts_for(self, op: str) -> int:
+        return max(1, int(self.op_attempts.get(op, self.attempts)))
+
+
+class CircuitBreaker:
+    """Closed -> open after ``threshold`` CONSECUTIVE failures; open
+    refuses instantly for ``reset_s``; then one half-open probe is let
+    through and its outcome closes or re-opens the circuit."""
+
+    def __init__(self, threshold: int = 5, reset_s: float = 10.0):
+        self.threshold = max(int(threshold), 1)
+        self.reset_s = float(reset_s)
+        self.consec_failures = 0
+        self.opens = 0                 # closed->open transitions (lifetime)
+        self._opened_at: float | None = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._probing or (
+            time.monotonic() - self._opened_at >= self.reset_s
+        ):
+            return "half-open"
+        return "open"
+
+    def allow(self) -> bool:
+        """Whether a call may go out now.  In the half-open window the
+        FIRST caller becomes the probe; siblings keep failing fast until
+        its outcome is known."""
+        if self._opened_at is None:
+            return True
+        if self._probing:
+            return False
+        if time.monotonic() - self._opened_at >= self.reset_s:
+            self._probing = True
+            return True
+        return False
+
+    def success(self) -> None:
+        self.consec_failures = 0
+        self._opened_at = None
+        self._probing = False
+
+    def failure(self) -> None:
+        self.consec_failures += 1
+        was_open = self._opened_at is not None
+        if self._probing or (
+            not was_open and self.consec_failures >= self.threshold
+        ):
+            # a failed probe re-opens with a fresh reset window; a closed
+            # breaker crossing the threshold opens for the first time
+            if not was_open:
+                self.opens += 1
+            self._opened_at = time.monotonic()
+            self._probing = False
+
+
+class FleetRpc:
+    """Retrying JSON-lines client for ONE edge (host:port), fronting
+    :func:`~fedrec_tpu.obs.fleet.request_json_line` with the policy's
+    backoff/deadline/breaker behavior.  Thread-compatible for the
+    churn-soak's logical workers: each worker owns its own instance, so
+    per-edge counters stay per-worker."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        policy: RpcPolicy | None = None,
+    ):
+        self.host = str(host)
+        self.port = int(port)
+        self.policy = policy or RpcPolicy()
+        self._rng = random.Random(self.policy.seed)
+        self.breaker = CircuitBreaker(
+            self.policy.breaker_threshold, self.policy.breaker_reset_s
+        )
+        self._born = time.monotonic()
+        self.last_ok: float | None = None   # monotonic ts of last success
+        # local accounting (the soak's logical workers synthesize their
+        # per-worker telemetry snapshots from these, since a shared
+        # process registry cannot keep 100 workers' edges apart)
+        self.ok = 0
+        self.errors = 0
+        self.retries = 0
+        self.op_errors: dict[str, int] = {}
+        self.op_ok: dict[str, int] = {}
+
+    # ------------------------------------------------------------ metrics
+    @property
+    def peer(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _m_retry(self, op: str) -> None:
+        from fedrec_tpu.obs import get_registry
+
+        get_registry().counter(
+            "wire.retries_total",
+            "request re-attempts after a transport failure per edge (the "
+            "resilient-RPC budget at work; 0 on a healthy wire)",
+            labels=("peer", "op"),
+        ).inc(peer=self.peer, op=op)
+
+    def _m_breaker(self, opened: bool) -> None:
+        from fedrec_tpu.obs import get_registry
+
+        reg = get_registry()
+        if opened:
+            reg.counter(
+                "wire.circuit_open_total",
+                "circuit-breaker closed->open transitions per edge (the "
+                "peer stayed dead past the consecutive-failure threshold)",
+                labels=("peer",),
+            ).inc(peer=self.peer)
+        reg.gauge(
+            "wire.circuit_state",
+            "circuit-breaker state per edge: 0 closed, 1 half-open, "
+            "2 open (open = calls fail fast, training continues degraded)",
+            labels=("peer",),
+        ).set(
+            {"closed": 0.0, "half-open": 1.0, "open": 2.0}[
+                self.breaker.state
+            ],
+            peer=self.peer,
+        )
+
+    # --------------------------------------------------------------- call
+    def unreachable_for(self) -> float:
+        """Seconds since the last successful exchange on this edge (since
+        construction when none succeeded yet) — the caller's degrade
+        clock (``agg.worker_unreachable_budget_s``)."""
+        anchor = self.last_ok if self.last_ok is not None else self._born
+        return time.monotonic() - anchor
+
+    def call(self, req: dict, op: str | None = None) -> dict:
+        """One exchange with retry.  Raises ``OSError`` once the attempt
+        budget is spent (or instantly while the breaker is open) and
+        ``ValueError`` on an application error reply (never retried: the
+        peer answered)."""
+        from fedrec_tpu.obs.fleet import request_json_line
+
+        op = op or str(req.get("cmd", "req"))
+        budget = self.policy.attempts_for(op)
+        last_err: OSError | None = None
+        for attempt in range(budget):
+            if not self.breaker.allow():
+                self.errors += 1
+                self.op_errors[op] = self.op_errors.get(op, 0) + 1
+                self._m_breaker(opened=False)
+                raise CircuitOpen(
+                    f"circuit open for {self.peer} (op={op}): "
+                    f"{self.breaker.consec_failures} consecutive failures, "
+                    f"probing again in <= {self.breaker.reset_s:g}s"
+                )
+            try:
+                resp = request_json_line(
+                    self.host, self.port, req,
+                    timeout_s=self.policy.read_timeout_s,
+                    connect_timeout_s=self.policy.connect_timeout_s,
+                    op=op,
+                )
+            except OSError as e:
+                last_err = e
+                before = self.breaker.opens
+                self.breaker.failure()
+                self.errors += 1
+                self.op_errors[op] = self.op_errors.get(op, 0) + 1
+                self._m_breaker(opened=self.breaker.opens > before)
+                if attempt + 1 < budget and self.breaker.state != "open":
+                    self.retries += 1
+                    self._m_retry(op)
+                    time.sleep(backoff_delay_s(
+                        attempt, self.policy.backoff_base_ms,
+                        self.policy.backoff_max_ms, self._rng,
+                    ))
+                    continue
+                raise
+            except ValueError:
+                # the peer is alive and answered — liveness for the
+                # breaker and the degrade clock, but the error propagates
+                self.breaker.success()
+                self.last_ok = time.monotonic()
+                self._m_breaker(opened=False)
+                raise
+            self.breaker.success()
+            self.ok += 1
+            self.op_ok[op] = self.op_ok.get(op, 0) + 1
+            self.last_ok = time.monotonic()
+            self._m_breaker(opened=False)
+            return resp
+        raise last_err if last_err is not None else OSError(
+            f"no attempt budget for op {op!r}"
+        )
+
+    # ---------------------------------------------------------- telemetry
+    def wire_snapshot_rows(self) -> dict:
+        """This edge's per-op request/error totals in registry-snapshot
+        row shape (``wire.requests_total`` / ``wire.errors_total``) — the
+        churn soak's logical workers feed these to the fleet watch rules
+        as their per-worker telemetry snapshots."""
+        def rows(table: dict[str, int]) -> list[dict]:
+            return [
+                {"labels": {"peer": self.peer, "op": o}, "value": float(n)}
+                for o, n in sorted(table.items())
+            ]
+
+        return {
+            "wire.requests_total": {
+                "kind": "counter", "values": rows(self.op_ok),
+            },
+            "wire.errors_total": {
+                "kind": "counter", "values": rows(self.op_errors),
+            },
+        }

@@ -80,12 +80,17 @@ class ServingClient:
 
     # ------------------------------------------------------------ plumbing
     def backoff_delay_s(self, attempt: int) -> float:
-        """Full-jitter exponential backoff (AWS-style): U(0, min(cap,
-        base·2^attempt)). Jitter matters as much as the exponent — a
+        """Full-jitter exponential backoff, delegated to the fleet-wide
+        policy module (:func:`fedrec_tpu.parallel.rpc.backoff_delay_s`)
+        so the serving client and the async worker's resilient RPC share
+        ONE retry shape. Jitter matters as much as the exponent — a
         restarted server must not meet every client's retry in one
         synchronized stampede."""
-        cap = min(self.backoff_max_ms, self.backoff_base_ms * (2 ** attempt))
-        return self._rng.uniform(0.0, cap) / 1e3
+        from fedrec_tpu.parallel.rpc import backoff_delay_s
+
+        return backoff_delay_s(
+            attempt, self.backoff_base_ms, self.backoff_max_ms, self._rng
+        )
 
     async def _drop(self) -> None:
         w, self._reader, self._writer = self._writer, None, None

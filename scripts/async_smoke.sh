@@ -434,4 +434,152 @@ PY
 kill -TERM "$SK_PID"
 wait "$SK_PID" 2>/dev/null || true
 
+# ------------------------------------------- [8] the fault-injection leg:
+# a fresh 2-worker cluster where the WIRE itself misbehaves — worker 0
+# dials the authority through an in-process chaos proxy that drops 30%
+# of its connections and tears two mid-run windows mid-message; worker 1's
+# proxy DUPLICATES every push (the lost-ack re-delivery case) — and the
+# authority is SIGTERM-killed mid-run for a 10 s outage, then respawned
+# from its state sidecars on the same port. Must prove: both workers
+# still exit 0 (parked pushes, stale progress, re-hello on the
+# incarnation bump), the respawn resumes the committed global, the
+# commit version keeps advancing past the pre-kill version (no lost
+# commit), and every duplicated delivery is detected by the push ledger
+# instead of double-folded.
+FPORT=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
+ROUNDS_F=12
+spawn_fault_authority() {
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m fedrec_tpu.agg.server "127.0.0.1:$FPORT" \
+        --quorum 2 --world 2 \
+        --obs-dir "$OUT/obs_fault/worker_aggserver" \
+        --state-dir "$OUT/aggstate_fault" \
+        >> "$OUT/aggserver_fault.log" 2>&1 &
+    FAULT_PID=$!
+}
+spawn_fault_authority
+cleanup() { kill "$AGG_PID" "$COLL_PID" "$SK_PID" "$FAULT_PID" 2>/dev/null || true; }
+sleep 1
+
+run_fault_worker() {
+    local faults="$2" seed="$3"
+    env -u PALLAS_AXON_POOL_IPS -u XLA_FLAGS JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m fedrec_tpu.cli.run "$ROUNDS_F" 8 10 \
+        --agg-server "127.0.0.1:$FPORT" --worker-id "$1" \
+        --strategy param_avg --clients 1 \
+        --synthetic --synthetic-train 256 --synthetic-news 64 \
+        --set model.bert_hidden=48 --set data.max_his_len=10 \
+        --set data.max_title_len=12 --set model.news_dim=32 \
+        --set model.num_heads=4 --set model.head_dim=8 \
+        --set model.query_dim=16 \
+        --set "train.snapshot_dir=$OUT/f$1" \
+        --set "train.eval_every=$ROUNDS_F" \
+        --set optim.user_lr=0.001 --set optim.news_lr=0.001 \
+        --set "obs.dir=$OUT/obs_fault" \
+        --set chaos.enabled=true --set chaos.straggle_ms=1200 \
+        --set "chaos.wire_faults=$faults" --set "chaos.wire_seed=$seed" \
+        --set agg.worker_timeout_s=6 --set agg.worker_global_wait_s=6 \
+        --set agg.worker_rpc_attempts=6 \
+        > "$OUT/worker_f$1.log" 2>&1
+}
+
+F_PIDS=()
+run_fault_worker 0 'drop@*:0.3,tear@10-14,tear@20-24' 1 & F_PIDS+=($!)
+run_fault_worker 1 'dup@*' 2 & F_PIDS+=($!)
+
+# wait for the first commits, then SIGTERM the authority mid-run
+V_KILL=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    FPORT="$FPORT" python - <<'PY'
+import os
+import time
+
+from fedrec_tpu.obs.fleet import request_json_line
+
+deadline = time.monotonic() + 120
+v = -1
+while time.monotonic() < deadline:
+    try:
+        st = request_json_line(
+            "127.0.0.1", int(os.environ["FPORT"]), {"cmd": "status"},
+            timeout_s=5.0,
+        )
+        v = int(st["version"])
+        if v >= 2:
+            break
+    except (OSError, ValueError):
+        pass
+    time.sleep(0.3)
+print(v)
+PY
+)
+[ "$V_KILL" -ge 2 ] \
+    || { echo "[async-smoke] fault leg never reached v2 before the kill"; \
+         tail -n 40 "$OUT"/worker_f*.log "$OUT/aggserver_fault.log"; exit 1; }
+kill -TERM "$FAULT_PID"
+wait "$FAULT_PID" 2>/dev/null || true
+echo "[async-smoke] fault leg: authority killed at v$V_KILL, 10 s outage"
+sleep 10
+spawn_fault_authority
+grep -q "resumed committed global" "$OUT/aggserver_fault.log" || sleep 2
+
+F_FAIL=0
+for i in 0 1; do
+    wait "${F_PIDS[$i]}" || { echo "[async-smoke] fault worker $i FAILED"; F_FAIL=1; }
+done
+if [ "$F_FAIL" -ne 0 ]; then
+    echo "[async-smoke] fault leg logs:"
+    tail -n 40 "$OUT"/worker_f*.log "$OUT/aggserver_fault.log"
+    exit 1
+fi
+
+# the respawn resumed the persisted committed global (not a cold init)
+grep -q "resumed committed global" "$OUT/aggserver_fault.log" \
+    || { echo "[async-smoke] respawned authority never resumed the sidecar"; \
+         cat "$OUT/aggserver_fault.log"; exit 1; }
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    OUT="$OUT" FPORT="$FPORT" V_KILL="$V_KILL" \
+    python - <<'PY'
+import json
+import os
+
+from fedrec_tpu.obs.fleet import request_json_line
+
+v_kill = int(os.environ["V_KILL"])
+st = request_json_line(
+    "127.0.0.1", int(os.environ["FPORT"]), {"cmd": "status"}, timeout_s=10.0
+)
+print("[async-smoke] fault aggserver status:", json.dumps(st)[:400])
+
+# no lost commit: the restored authority advertises incarnation 2 and the
+# version kept advancing PAST the pre-kill version once the workers'
+# parked pushes drained
+assert st["incarnation"] == 2, st["incarnation"]
+assert st["version"] > v_kill, (
+    f"version stuck at v{st['version']} after restart at v{v_kill}"
+)
+assert all(c["quorum"] >= 2 for c in st["commits"]), st["commits"]
+
+# no double-fold: worker 1's edge duplicated every push in flight — the
+# ledger must have answered `duplicate` for the re-deliveries instead of
+# folding them twice
+assert st["push_dups"] >= 1, (
+    f"dup@* edge produced no detected duplicates: {st['push_dups']}"
+)
+print(f"[async-smoke] fault leg OK (v{v_kill} -> v{st['version']} across "
+      f"the outage, {st['push_dups']} duplicate push(es) detected, "
+      "0 double-folded)")
+PY
+
+kill -TERM "$FAULT_PID"
+wait "$FAULT_PID" 2>/dev/null || true
+
 echo "[async-smoke] OK"

@@ -384,9 +384,11 @@ def test_pusher_backs_off_after_consecutive_failures():
     # so the failure counter stays put and no round stalls on the timeout)
     assert p.push() is False
     assert p.failures == p._BACKOFF_AFTER
-    # ...but the once-per-run final push still tries
+    # ...but the once-per-run final push still tries — with ONE bounded
+    # retry, so a dead endpoint costs exactly two counted attempts
+    p._FINAL_RETRY_DELAY_S = 0.0
     assert p.push(final=True) is False
-    assert p.failures == p._BACKOFF_AFTER + 1
+    assert p.failures == p._BACKOFF_AFTER + 2
 
 
 def test_membership_server_routes_telemetry(tmp_path):

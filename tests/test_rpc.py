@@ -1,0 +1,443 @@
+"""Resilient fleet RPC + wire-level chaos: backoff/breaker policy,
+retry-through-faults on a real socket, the chaos proxy's byte-verbatim
+passthrough pin, and the fleet watch's partitioned-edge rule."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedrec_tpu.fed.chaos import ChaosProxy, WireFaultPlan, parse_wire_faults
+from fedrec_tpu.obs import MetricsRegistry, set_registry
+from fedrec_tpu.obs.fleet import request_json_line
+from fedrec_tpu.parallel.rpc import (
+    RC_DEGRADED,
+    AuthorityUnreachable,
+    CircuitBreaker,
+    CircuitOpen,
+    FleetRpc,
+    RpcPolicy,
+    backoff_delay_s,
+    new_push_id,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    set_registry(MetricsRegistry())
+    yield
+    set_registry(MetricsRegistry())
+
+
+# ---------------------------------------------------------------- backoff
+def test_backoff_full_jitter_bounds():
+    import random
+
+    rng = random.Random(0)
+    for attempt in range(8):
+        cap_s = min(2000.0, 50.0 * 2 ** attempt) / 1e3
+        for _ in range(20):
+            d = backoff_delay_s(attempt, 50.0, 2000.0, rng)
+            assert 0.0 <= d <= cap_s
+
+
+def test_backoff_seeded_stream_is_deterministic():
+    import random
+
+    a = [backoff_delay_s(i, rng=random.Random(7)) for i in range(4)]
+    b = [backoff_delay_s(i, rng=random.Random(7)) for i in range(4)]
+    assert a == b
+
+
+def test_serving_client_delegates_same_backoff_shape():
+    """serving.client's backoff IS the fleet policy's — one retry shape
+    on every wire client (the absorb-the-duplication contract)."""
+    import random
+
+    from fedrec_tpu.serving.client import ServingClient
+
+    cli = ServingClient("127.0.0.1", 1, seed=11)
+    ref_rng = random.Random(11)
+    got = [cli.backoff_delay_s(i) for i in range(5)]
+    want = [backoff_delay_s(i, 50.0, 2000.0, ref_rng) for i in range(5)]
+    assert got == want
+
+
+def test_new_push_id_shape_and_uniqueness():
+    ids = {new_push_id("w3", 5) for _ in range(64)}
+    assert len(ids) == 64
+    assert all(i.startswith("w3:5:") for i in ids)
+
+
+# ---------------------------------------------------------------- breaker
+def test_circuit_breaker_transitions():
+    br = CircuitBreaker(threshold=2, reset_s=0.05)
+    assert br.state == "closed" and br.allow()
+    br.failure()
+    assert br.state == "closed"
+    br.failure()
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow()                       # fail fast while open
+    time.sleep(0.06)
+    assert br.state == "half-open"
+    assert br.allow()                           # first caller is the probe
+    assert not br.allow()                       # siblings still refused
+    br.failure()                                # failed probe re-opens
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.allow()
+    br.success()                                # probe landed: closed again
+    assert br.state == "closed" and br.consec_failures == 0
+
+
+def test_rc_degraded_rides_the_exception():
+    assert RC_DEGRADED == 75
+    assert AuthorityUnreachable("x").returncode == 75
+
+
+# --------------------------------------------------------- wire fixtures
+def _echo_server():
+    """One-shot JSON-lines echo server; returns (sock, port, hits list)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(0.2)
+    port = srv.getsockname()[1]
+    hits: list[dict] = []
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                conn.settimeout(5.0)
+                buf = b""
+                try:
+                    while b"\n" not in buf:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    if b"\n" not in buf:
+                        continue
+                    req = json.loads(buf.split(b"\n", 1)[0])
+                    hits.append(req)
+                    conn.sendall(
+                        (json.dumps({"echo": req.get("x")}) + "\n").encode()
+                    )
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return srv, port, hits, stop
+
+
+@pytest.fixture()
+def echo():
+    srv, port, hits, stop = _echo_server()
+    yield port, hits
+    stop.set()
+    srv.close()
+
+
+def _policy(**kw):
+    base = dict(
+        connect_timeout_s=2.0, read_timeout_s=5.0, attempts=4,
+        backoff_base_ms=5.0, backoff_max_ms=20.0, seed=0,
+    )
+    base.update(kw)
+    return RpcPolicy(**base)
+
+
+# --------------------------------------------------------------- FleetRpc
+def test_fleet_rpc_roundtrip_and_accounting(echo):
+    port, _ = echo
+    rpc = FleetRpc("127.0.0.1", port, _policy())
+    assert rpc.call({"cmd": "t", "x": 3})["echo"] == 3
+    assert rpc.ok == 1 and rpc.errors == 0
+    assert rpc.op_ok == {"t": 1}
+    assert rpc.unreachable_for() < 5.0
+
+
+def test_fleet_rpc_retries_through_transient_deadness(echo):
+    port, _ = echo
+    # a proxy that drops the first connections then forwards: seed 5
+    # gives a mixed drop pattern at p=0.5; the budget of 6 rides it out
+    proxy = ChaosProxy(
+        "127.0.0.1", port, plan=WireFaultPlan("drop@*:0.5", seed=5)
+    ).start()
+    try:
+        rpc = FleetRpc(proxy.host, proxy.port, _policy(attempts=6))
+        for i in range(4):
+            assert rpc.call({"cmd": "t", "x": i})["echo"] == i
+        assert rpc.retries >= 1
+        rows = rpc.wire_snapshot_rows()
+        assert rows["wire.requests_total"]["values"][0]["value"] == 4.0
+        assert rows["wire.errors_total"]["values"][0]["value"] >= 1.0
+    finally:
+        proxy.stop()
+
+
+def test_fleet_rpc_budget_exhaustion_raises_oserror():
+    # nothing listens on this port: every dial fails fast
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        dead_port = s.getsockname()[1]
+    rpc = FleetRpc("127.0.0.1", dead_port, _policy(attempts=2))
+    with pytest.raises(OSError):
+        rpc.call({"cmd": "t"})
+    assert rpc.errors == 2 and rpc.retries == 1
+    assert rpc.unreachable_for() >= 0.0
+
+
+def test_fleet_rpc_breaker_opens_and_fails_fast():
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        dead_port = s.getsockname()[1]
+    rpc = FleetRpc(
+        "127.0.0.1", dead_port,
+        _policy(attempts=3, breaker_threshold=3, breaker_reset_s=60.0),
+    )
+    with pytest.raises(OSError):
+        rpc.call({"cmd": "t"})
+    assert rpc.breaker.state == "open"
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpen):
+        rpc.call({"cmd": "t"})
+    assert time.monotonic() - t0 < 0.5          # no connect timeout burned
+
+
+def test_fleet_rpc_application_error_not_retried():
+    # an error reply is a live peer answering: ValueError, one delivery
+    err_srv = socket.create_server(("127.0.0.1", 0))
+    err_srv.settimeout(0.2)
+    stop = threading.Event()
+    calls = []
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = err_srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                calls.append(1)
+                conn.sendall(b'{"error": "rebase: nope"}\n')
+
+    threading.Thread(target=loop, daemon=True).start()
+    try:
+        rpc = FleetRpc(
+            "127.0.0.1", err_srv.getsockname()[1], _policy(attempts=4)
+        )
+        with pytest.raises(ValueError, match="rebase"):
+            rpc.call({"cmd": "push"})
+        assert len(calls) == 1                  # never re-asked
+        assert rpc.last_ok is not None          # the peer IS alive
+    finally:
+        stop.set()
+        err_srv.close()
+
+
+# ------------------------------------------------------------ wire faults
+def test_parse_wire_faults_windows_and_args():
+    entries = parse_wire_faults(
+        "tear@2-4,dup@5-8:3,partition@20-30,drop@*:0.3,delay@1:250"
+    )
+    assert ("tear", 2.0, 4.0, 0.0) in entries
+    assert ("dup", 5.0, 8.0, 3.0) in entries
+    assert ("partition", 20.0, 30.0, 0.0) in entries
+    assert ("drop", 0.0, float("inf"), 0.3) in entries
+    assert ("delay", 1.0, 2.0, 250.0) in entries  # single t -> [t, t+1)
+
+
+@pytest.mark.parametrize("bad", [
+    "tear",                 # no window
+    "tear@4-2",             # empty window
+    "warp@1-2",             # unknown kind
+    "drop@x-y",             # unparsable times
+])
+def test_parse_wire_faults_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_wire_faults(bad)
+
+
+def test_wire_fault_plan_is_deterministic():
+    a = WireFaultPlan("drop@*:0.4", seed=9)
+    b = WireFaultPlan("drop@*:0.4", seed=9)
+    fates = [
+        [bool(p.actions(1.0, i)) for i in range(32)] for p in (a, b)
+    ]
+    assert fates[0] == fates[1]
+    assert any(fates[0]) and not all(fates[0])  # p=0.4 is a real mix
+
+
+def test_chaos_proxy_passthrough_is_byte_verbatim(echo):
+    """The chaos-off pin: with no plan the proxy forwards request and
+    reply bytes verbatim — a chaos-disabled run cannot differ on the
+    wire by construction."""
+    port, hits = echo
+    proxy = ChaosProxy("127.0.0.1", port).start()
+    try:
+        line = b'{"cmd": "t", "x": 42, "pad": "\\u00e9"}\n'
+        with socket.create_connection(
+            ("127.0.0.1", port), timeout=5
+        ) as c:
+            c.sendall(line)
+            direct = c.recv(65536)
+        with socket.create_connection(
+            (proxy.host, proxy.port), timeout=5
+        ) as c:
+            c.sendall(line)
+            proxied = c.recv(65536)
+        assert proxied == direct
+        assert hits[0] == hits[1]               # upstream saw identical reqs
+        assert proxy.injected == {}             # nothing was faulted
+    finally:
+        proxy.stop()
+
+
+def test_chaos_proxy_tear_is_ackless_close(echo):
+    port, hits = echo
+    proxy = ChaosProxy(
+        "127.0.0.1", port, plan=WireFaultPlan("tear@0-600")
+    ).start()
+    try:
+        with pytest.raises(OSError):
+            request_json_line(
+                proxy.host, proxy.port, {"cmd": "t", "x": 1}, timeout_s=5
+            )
+        assert proxy.injected.get("tear", 0) == 1
+        assert hits == []                       # no full line got through
+    finally:
+        proxy.stop()
+
+
+def test_chaos_proxy_dup_delivers_twice(echo):
+    port, hits = echo
+    proxy = ChaosProxy(
+        "127.0.0.1", port, plan=WireFaultPlan("dup@0-600")
+    ).start()
+    try:
+        resp = request_json_line(
+            proxy.host, proxy.port, {"cmd": "t", "x": 7}, timeout_s=5
+        )
+        assert resp["echo"] == 7                # client still gets a reply
+        assert len(hits) == 2                   # upstream saw it twice
+        assert hits[0] == hits[1]
+        assert proxy.injected.get("dup", 0) == 1
+    finally:
+        proxy.stop()
+
+
+def test_chaos_proxy_partition_blocks_the_window(echo):
+    port, hits = echo
+    proxy = ChaosProxy(
+        "127.0.0.1", port, plan=WireFaultPlan("partition@0-600")
+    ).start()
+    try:
+        with pytest.raises(OSError):
+            request_json_line(
+                proxy.host, proxy.port, {"cmd": "t", "x": 1}, timeout_s=5
+            )
+        assert hits == []
+        assert proxy.injected.get("partition", 0) == 1
+    finally:
+        proxy.stop()
+
+
+# --------------------------------------------- fleet partitioned-edge rule
+def _wire_snap(ts, peer, ok, errs):
+    return {
+        "ts": ts,
+        "metrics": {
+            "wire.requests_total": {
+                "kind": "counter",
+                "values": [
+                    {"labels": {"peer": peer, "op": "push"}, "value": ok}
+                ],
+            },
+            "wire.errors_total": {
+                "kind": "counter",
+                "values": [
+                    {"labels": {"peer": peer, "op": "push"}, "value": errs}
+                ],
+            },
+        },
+    }
+
+
+def test_fleet_rules_partitioned_edge_names_the_peer():
+    from fedrec_tpu.config import WatchConfig
+    from fedrec_tpu.obs.watch import FleetRules
+
+    cfg = WatchConfig()
+    cfg.fleet_stalled_pushes = 2
+    rules = FleetRules(cfg)
+    peer = "127.0.0.1:9999"
+    # errors grow push over push, requests frozen -> partition fires
+    for i, errs in enumerate([1.0, 4.0, 9.0, 15.0]):
+        rules.observe_push("w7", _wire_snap(100.0 + i, peer, 5.0, errs))
+    active = {a["key"]: a for a in rules.engine.active()}
+    key = f"fleet:partition:w7->{peer}"
+    assert key in active
+    assert active[key]["labels"]["peer"] == peer
+    assert active[key]["labels"]["worker"] == "w7"
+    assert "partitioned edge" in active[key]["summary"]
+
+
+def test_fleet_rules_healthy_edge_never_fires():
+    from fedrec_tpu.config import WatchConfig
+    from fedrec_tpu.obs.watch import FleetRules
+
+    cfg = WatchConfig()
+    cfg.fleet_stalled_pushes = 2
+    rules = FleetRules(cfg)
+    peer = "127.0.0.1:9999"
+    # errors grow but requests grow too (flaky-but-working edge)
+    for i in range(5):
+        rules.observe_push(
+            "w1", _wire_snap(100.0 + i, peer, 5.0 + i, float(i))
+        )
+    assert not [
+        a for a in rules.engine.active()
+        if a["key"].startswith("fleet:partition:")
+    ]
+
+
+# -------------------------------------------------- final-push retry (obs)
+def test_fleet_pusher_final_push_gets_one_retry(tmp_path, monkeypatch):
+    from fedrec_tpu.obs import fleet as fleet_mod
+    from fedrec_tpu.obs.fleet import FleetPusher
+
+    calls = {"n": 0}
+
+    def flaky(host, port, req, timeout_s, op=None, connect_timeout_s=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("torn")
+        return {"ok": True}
+
+    monkeypatch.setattr(fleet_mod, "request_json_line", flaky)
+    monkeypatch.setattr(FleetPusher, "_FINAL_RETRY_DELAY_S", 0.0)
+    pusher = FleetPusher("127.0.0.1:1", worker="w0", registry=MetricsRegistry())
+    assert pusher.push(final=True) is True
+    assert calls["n"] == 2                      # failed once, retried once
+    assert pusher.failures == 1
+
+    calls["n"] = 0
+    pusher2 = FleetPusher("127.0.0.1:1", worker="w0", registry=MetricsRegistry())
+    assert pusher2.push() in (True, False)      # non-final: single attempt
+    assert calls["n"] == 1
